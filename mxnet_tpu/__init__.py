@@ -1,0 +1,54 @@
+"""mxnet_tpu — a TPU-native framework with the capabilities of Apache MXNet.
+
+Import convention (same surface as the reference `python/mxnet/__init__.py`):
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+
+Substrate: JAX/XLA/Pallas. The reference's C++ engine/executor/kvstore stack
+is replaced by XLA's async runtime, jit tracing, and ICI collectives; see
+SURVEY.md §7 for the design mapping.
+"""
+from .base import MXNetError, __version__
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import engine
+from . import random
+from .random import seed
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import initializer
+from . import init  # alias module
+from .initializer import Xavier
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from . import module
+from . import module as mod
+from . import io
+from . import recordio
+from . import kvstore as kv
+from .kvstore import create as kvstore_create
+from . import callback
+from . import model
+from .model import FeedForward
+from . import gluon
+from . import image
+from . import profiler
+from . import visualization
+from .visualization import print_summary
+from . import monitor
+from .monitor import Monitor
+from . import test_utils
+from . import parallel
+from .attribute import AttrScope
+from .name import NameManager
+
+__all__ = ["nd", "ndarray", "sym", "symbol", "module", "mod", "io", "kv",
+           "gluon", "autograd", "optimizer", "metric", "initializer",
+           "Context", "cpu", "gpu", "tpu", "MXNetError"]

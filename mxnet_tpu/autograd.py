@@ -1,0 +1,301 @@
+"""Imperative autograd: tape-based reverse-mode differentiation.
+
+Parity with reference `python/mxnet/autograd.py` (record/pause/train_mode/
+predict_mode/backward/grad/Function) and the C++ tape in
+`src/imperative/imperative.cc:182,358` (RecordOp/Backward).
+
+Design (TPU-native): instead of re-building an NNVM gradient graph, each
+recorded op captures its `jax.vjp` closure at dispatch time — the residuals
+live as device buffers, and backward is a reverse topological sweep calling
+the stored vjps. This matches XLA's functional model: no gradient graph pass,
+no kAddTo buffers; accumulation is functional adds.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "backward", "grad", "mark_variables", "Function"]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.recording = False
+        self.training = False
+        self.node_count = 0
+
+
+_STATE = _AGState()
+
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec = recording
+        self._train = training
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        _STATE.recording, _STATE.training = self._saved
+        return False
+
+
+def record(train_mode=True):  # noqa: D401 - reference API name
+    """`with autograd.record():` — reference autograd.py:103."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(flag):
+    prev = _STATE.recording
+    _STATE.recording = flag
+    return prev
+
+
+def set_training(flag):
+    prev = _STATE.training
+    _STATE.training = flag
+    return prev
+
+
+class Node:
+    """One recorded op on the tape (reference AGInfo, imperative.h:59-95)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "seq", "name")
+
+    def __init__(self, vjp_fn, inputs, out_shapes, out_dtypes, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs            # list[NDArray]
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.name = name
+        _STATE.node_count += 1
+        self.seq = _STATE.node_count
+
+
+def _zero_cotangent(shape, dtype):
+    dtype = np.dtype(dtype)
+    if np.issubdtype(dtype, np.inexact):
+        import jax.numpy as jnp
+        return jnp.zeros(shape, dtype)
+    # integer/bool outputs carry float0 cotangents in JAX
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from output NDArrays, accumulating into leaf ``.grad``.
+
+    Mirrors reference `Imperative::Backward` (imperative.cc:358): default head
+    gradient is ones for each head; grads land in arrays attached by
+    ``attach_grad`` honoring their grad_req (write/add/null).
+    """
+    from .ndarray.ndarray import NDArray  # late import, avoids cycle
+    import jax.numpy as jnp
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads length mismatch")
+
+    # Collect reachable nodes.
+    nodes = {}
+
+    def visit(node):
+        if node is None or node.seq in nodes:
+            return
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.seq in nodes:
+                continue
+            nodes[n.seq] = n
+            for x in n.inputs:
+                if x._autograd_node is not None:
+                    stack.append(x._autograd_node[0])
+
+    # cotangent accumulators: per node -> list per output; per leaf id -> value
+    node_cots = {}
+    leaf_cots = {}
+    leaves = {}
+
+    def add_cot(arr, cot):
+        if arr._autograd_node is not None:
+            node, idx = arr._autograd_node
+            store = node_cots.setdefault(node.seq, [None] * len(node.out_shapes))
+            store[idx] = cot if store[idx] is None else store[idx] + cot
+        if arr._requires_grad:
+            key = id(arr)
+            leaves[key] = arr
+            leaf_cots[key] = cot if key not in leaf_cots else leaf_cots[key] + cot
+
+    any_tape = False
+    for h, hg in zip(heads, head_grads):
+        if h._autograd_node is None and not h._requires_grad:
+            continue
+        any_tape = True
+        if h._autograd_node is not None:
+            visit(h._autograd_node[0])
+        cot = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        add_cot(h, cot)
+    if not any_tape:
+        raise MXNetError(
+            "this array is not attached to any computation graph; "
+            "run operations inside autograd.record() first")
+
+    for seq in sorted(nodes, reverse=True):
+        node = nodes[seq]
+        cots = node_cots.get(seq)
+        if cots is None:
+            continue
+        full = [c if c is not None else _zero_cotangent(s, d)
+                for c, (s, d) in zip(cots, zip(node.out_shapes, node.out_dtypes))]
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "computation graph was already freed by a previous backward; "
+                "pass retain_graph=True to backward() to keep it")
+        in_cots = node.vjp_fn(tuple(full))
+        for x, c in zip(node.inputs, in_cots):
+            if c is None or (hasattr(c, "dtype") and c.dtype == jax.dtypes.float0):
+                continue
+            add_cot(x, c)
+        node_cots.pop(seq, None)
+
+    # write into .grad respecting grad_req
+    for key, arr in leaves.items():
+        if arr.grad is None or arr._grad_req == "null":
+            continue
+        cot = leaf_cots[key].astype(arr.dtype)
+        if arr._grad_req == "add":
+            arr.grad._data = arr.grad._data + cot
+        else:
+            arr.grad._data = cot
+
+    if not retain_graph:
+        for node in nodes.values():
+            node.vjp_fn = None
+            node.inputs = ()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Reference `autograd.grad`: return grads of heads w.r.t. variables."""
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher order imperative "
+                                  "grad): use mx.np_grad / jax.grad composition")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._requires_grad, v._grad_req, v.grad) for v in variables]
+    for v in variables:
+        v.attach_grad("write")
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        out = [v.grad.copy() for v in variables]
+    finally:
+        for v, (req, greq, g) in zip(variables, saved):
+            v._requires_grad = req
+            v._grad_req = greq
+            v.grad = g
+    return out[0] if single else out
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference `autograd.mark_variables`."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._requires_grad = True
+        v._grad_req = req
+        v.grad = g
+
+
+def get_symbol(x):  # pragma: no cover - graph introspection stub
+    raise NotImplementedError("autograd.get_symbol: use Symbol tracing instead")
+
+
+class Function:
+    """Customized differentiable function (reference autograd.py Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` over NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap_like
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn = self
+
+            def vjp_fn(cots):
+                from .ndarray.ndarray import array as _nd_array
+                with pause():
+                    cot_nds = [_wrap_like(c, o) for c, o in zip(cots, outs)]
+                    in_grads = fn.backward(*cot_nds)
+                if isinstance(in_grads, NDArray):
+                    in_grads = [in_grads]
+                return [g._data if g is not None else None for g in in_grads]
+
+            node = Node(vjp_fn, list(inputs),
+                        [o.shape for o in outs], [o.dtype for o in outs],
+                        name=type(self).__name__)
+            for i, o in enumerate(outs):
+                o._autograd_node = (node, i)
+        return outputs

@@ -1,0 +1,88 @@
+"""Base types, error handling and small shared helpers.
+
+Capability parity with the reference's `include/mxnet/base.h` and
+`python/mxnet/base.py` (dtype tables, error type, name manager). There is no
+C-API/ctypes boundary here: the TPU-native stack is pure Python over
+JAX/XLA, with native (C++) components only where a real runtime need exists
+(IO pipeline, see `mxnet_tpu/io/`).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+
+# Version mirrors the reference framework version it provides parity with
+# (reference `include/mxnet/base.h:103-107` => 1.2.1) plus our own epoch.
+__version__ = "1.2.1+tpu0"
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (reference `python/mxnet/base.py` MXNetError)."""
+
+
+# dtype name <-> numpy mapping (reference `python/mxnet/base.py` _DTYPE_NP_TO_MX).
+_DTYPE_NP_TO_MX = {
+    None: -1,
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    np.dtype(np.bool_): 7,
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+# float64 is a supported NDArray dtype in the reference; enable it (Python
+# scalars stay weakly typed, so float32 remains the working default).
+try:  # pragma: no cover
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+except Exception:
+    pass
+
+# bfloat16 is first-class on TPU; expose it by name.
+try:  # pragma: no cover - jax always present in this environment
+    import jax.numpy as _jnp
+
+    bfloat16 = _jnp.bfloat16
+    _DTYPE_NP_TO_MX[np.dtype(bfloat16)] = 12
+    _DTYPE_MX_TO_NP[12] = np.dtype(bfloat16)
+except Exception:  # pragma: no cover
+    bfloat16 = None
+
+
+def dtype_np(dtype):
+    """Normalise a user-provided dtype (string/np.dtype/type) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        return np.dtype(bfloat16)
+    return np.dtype(dtype)
+
+
+class _NameManager(threading.local):
+    """Auto-naming for symbols/blocks (reference `python/mxnet/name.py`)."""
+
+    def __init__(self):
+        super().__init__()
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+
+name_manager = _NameManager()

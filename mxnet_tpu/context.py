@@ -1,0 +1,127 @@
+"""Device contexts.
+
+Parity with reference `include/mxnet/base.h:133-264` (`Context`) and
+`python/mxnet/context.py`. The TPU-native stack adds ``tpu(i)`` as the
+first-class accelerator context; ``gpu(i)`` is kept as an API-compatible alias
+that resolves to the platform accelerator so reference user code
+(``ctx=mx.gpu(0)``) runs unchanged on TPU hosts.
+
+A Context maps onto a concrete ``jax.Device``. On CPU-only test hosts
+(``JAX_PLATFORMS=cpu`` with ``--xla_force_host_platform_device_count=N``) the
+accelerator contexts resolve onto the virtual host devices so the full test
+suite runs without a chip.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_gpus", "num_tpus"]
+
+
+class Context:
+    """Device context, usable as `with ctx:` scope like the reference."""
+
+    # reference devtype ids (base.h:133+): cpu=1, gpu=2, cpu_pinned=3, cpu_shared=5.
+    # tpu=6 is new.
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- JAX mapping ------------------------------------------------------
+    def jax_device(self) -> "jax.Device":
+        """Resolve to a concrete jax.Device.
+
+        cpu -> host platform device; tpu/gpu -> accelerator device of the
+        default backend, falling back to host devices when no accelerator is
+        attached (CPU test mode).
+        """
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = jax.devices("cpu")
+            return devs[min(self.device_id, len(devs) - 1)]
+        devs = _accelerator_devices()
+        if not devs:
+            devs = jax.devices("cpu")
+        return devs[self.device_id % len(devs)]
+
+    def empty_cache(self):
+        """Reference `Context.empty_cache`; XLA manages its own pools: no-op."""
+
+
+def _accelerator_devices():
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return []
+    return [d for d in devs if d.platform != "cpu"]
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """API-compat alias: resolves onto the platform accelerator (TPU)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def num_gpus():
+    """Reference `mx.context.num_gpus`; counts attached accelerator chips."""
+    return len(_accelerator_devices())
+
+
+def num_tpus():
+    return len(_accelerator_devices())
+
+
+def current_context():
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value

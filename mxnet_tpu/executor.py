@@ -1,0 +1,380 @@
+"""Executor: compiled whole-graph execution.
+
+Parity with reference `include/mxnet/executor.h` / `src/executor/
+graph_executor.cc` (Bind/SimpleBind, Forward/Backward, outputs, monitor
+callback, shared-memory rebinding for bucketing).
+
+TPU-native design (SURVEY.md §7 stage 5): instead of NNVM passes + per-op
+engine pushes, binding builds a pure Python evaluator over the Symbol DAG and
+`jax.jit`s it — the whole graph becomes ONE XLA computation per
+(is_train, shapes) signature:
+
+- memory planning        -> XLA buffer assignment (replaces PlanMemory)
+- bulk exec segments     -> a single fused program (replaces graph_executor.cc:1377)
+- gradient graph         -> `jax.vjp` over the evaluator (replaces Gradient pass)
+- grad_req add/write     -> functional accumulation into grad buffers
+- device placement       -> ctx -> jax.Device; `__ctx_group__` attrs reserved
+                            for sharding annotations (parallel/)
+- dynamic shapes         -> jit retraces per shape signature; executors share
+                            parameter NDArrays (bucketing,
+                            reference shared_buffer graph_executor.h:105)
+
+Backward runs a fused forward+vjp XLA program: one full train step is one
+device dispatch, matching (and beating) the reference's bulked engine model.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, cpu
+from .ndarray.ndarray import NDArray, _from_data, zeros as nd_zeros
+from .ops.registry import get_op
+from .symbol.symbol import Symbol, _graph_infer
+
+__all__ = ["Executor"]
+
+
+def _build_eval(sym: Symbol):
+    """Build eval_fn(arg_vals, aux_vals, key, is_train) -> (outs, aux_updates).
+
+    Pure and traceable: one call under jit compiles the entire graph.
+    """
+    nodes = sym._topo_nodes()
+    sym._mark_aux()
+    out_index = [(id(n), i) for n, i in sym._outputs]
+
+    def eval_fn(arg_vals, aux_vals, key, is_train):
+        env = {}
+        aux_updates = {}
+        for seq, n in enumerate(nodes):
+            if n.is_var():
+                if n.name in arg_vals:
+                    env[id(n)] = [arg_vals[n.name]]
+                elif n.name in aux_vals:
+                    env[id(n)] = [aux_vals[n.name]]
+                else:
+                    raise MXNetError("unbound variable %s" % n.name)
+                continue
+            op = get_op(n.op)
+            params = {k: v for k, v in n.attrs.items() if k != "__attrs__"}
+            if op.need_train_flag:
+                params["_is_train"] = is_train
+            if op.need_rng:
+                params["_rng_key"] = jax.random.fold_in(key, seq)
+            ins = [env[id(src)][oi] for src, oi in n.inputs]
+            outs = op.fcompute(params, *ins)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            n_out = op.n_out(params)
+            if op.mutate_aux:
+                for ai, new_val in zip(op.mutate_aux, outs[n_out:]):
+                    src, _ = n.inputs[ai]
+                    if src.is_var():
+                        aux_updates[src.name] = new_val
+                outs = outs[:n_out]
+            env[id(n)] = list(outs)
+        return [env[nid][i] for nid, i in out_index], aux_updates
+
+    return eval_fn
+
+
+class Executor:
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = arg_dict            # name -> NDArray (shared, mutable)
+        self.grad_dict = grad_dict          # name -> NDArray or None
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req           # name -> 'write'|'add'|'null'
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._eval_fn = _build_eval(symbol)
+        self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
+        self._grad_names = [n for n in self._arg_names
+                            if grad_req.get(n, "null") != "null"]
+        self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
+        self.outputs = []
+        self._monitor = None
+        self._out_avals = None
+        self._fwd_snapshot = None
+
+    # -- construction ----------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, shared_buffer=None,
+                    **kwargs):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes_d, _, aux_shapes_d = _graph_infer(symbol, kwargs,
+                                                     type_dict=type_dict)
+        type_dict = type_dict or {}
+        req = _norm_req(grad_req, arg_names, kwargs)
+        arg_dict = {}
+        grad_dict = {}
+        for name in arg_names:
+            shape = arg_shapes_d.get(name)
+            if shape is None:
+                raise MXNetError("cannot infer shape of argument %s" % name)
+            dt = type_dict.get(name, np.float32)
+            if shared_exec is not None and name in shared_exec.arg_dict and \
+                    shared_exec.arg_dict[name].shape == tuple(shape):
+                arg_dict[name] = shared_exec.arg_dict[name]
+                if req.get(name, "null") != "null":
+                    grad_dict[name] = shared_exec.grad_dict.get(name)
+            elif shared_buffer is not None and name in shared_buffer and \
+                    shared_buffer[name].shape == tuple(shape):
+                arg_dict[name] = shared_buffer[name]
+            else:
+                arg_dict[name] = nd_zeros(shape, ctx=ctx, dtype=dt)
+                if shared_buffer is not None:
+                    shared_buffer[name] = arg_dict[name]
+            if req.get(name, "null") != "null" and name not in grad_dict:
+                grad_dict[name] = nd_zeros(shape, ctx=ctx, dtype=dt)
+        aux_dict = {}
+        for name in aux_names:
+            shape = aux_shapes_d.get(name)
+            if shape is None:
+                raise MXNetError("cannot infer shape of aux state %s" % name)
+            if shared_exec is not None and name in shared_exec.aux_dict and \
+                    shared_exec.aux_dict[name].shape == tuple(shape):
+                aux_dict[name] = shared_exec.aux_dict[name]
+            else:
+                aux_dict[name] = nd_zeros(shape, ctx=ctx,
+                                          dtype=type_dict.get(name, np.float32))
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+
+    @staticmethod
+    def bind(symbol, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_dict = _to_dict(args, arg_names, "args")
+        grad_dict = _to_dict(args_grad, arg_names, "args_grad") if args_grad else {}
+        aux_dict = _to_dict(aux_states, aux_names, "aux_states") if aux_states else {}
+        req = _norm_req(grad_req, arg_names, {})
+        if args_grad is None:
+            req = {n: "null" for n in arg_names}
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+
+    # -- execution -------------------------------------------------------
+    def _gather(self):
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        return arg_vals, aux_vals
+
+    def _next_key(self):
+        from . import random as _random
+        return _random.next_key(self._ctx)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k][:] = v
+        arg_vals, aux_vals = self._gather()
+        key = self._next_key()
+        if self._monitor is not None:
+            outs, aux_up = self._monitored_eval(arg_vals, aux_vals, is_train,
+                                                key)
+        else:
+            outs, aux_up = self._jit_fwd(arg_vals, aux_vals, key,
+                                         bool(is_train))
+        if is_train:
+            # snapshot of pre-update inputs + key so a following backward()
+            # recomputes the IDENTICAL forward (same dropout mask, idempotent
+            # aux updates) inside its fused fwd+vjp program
+            self._fwd_snapshot = (arg_vals, aux_vals, key)
+            for name, val in aux_up.items():
+                self.aux_dict[name]._data = val
+        self.outputs = [_from_data(v, self._ctx) for v in outs]
+        return self.outputs
+
+    def _fwd_bwd_impl(self, grad_args, other_args, aux_vals, key, head_grads):
+        def f(ga):
+            outs, aux_up = self._eval_fn({**other_args, **ga}, aux_vals, key, True)
+            return outs, aux_up
+
+        (outs, aux_up), vjp = jax.vjp(f, grad_args)
+        cots = []
+        for o, hg in zip(outs, head_grads):
+            if hg is not None:
+                cots.append(hg)
+            elif jnp.issubdtype(o.dtype, jnp.inexact):
+                cots.append(jnp.ones_like(o))
+            else:
+                cots.append(np.zeros(o.shape, jax.dtypes.float0))
+        zero_aux = jax.tree.map(
+            lambda a: np.zeros(a.shape, jax.dtypes.float0)
+            if not jnp.issubdtype(a.dtype, jnp.inexact) else jnp.zeros_like(a),
+            aux_up)
+        (grads,) = vjp((cots, zero_aux))
+        return outs, aux_up, grads
+
+    def forward_backward(self, out_grads=None, _snapshot=None, **kwargs):
+        """Fused forward+backward: one XLA dispatch per step (the fast path
+        used by Module.fit; the reference analog is bulked exec of the full
+        fwd+bwd graph)."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k][:] = v
+        if _snapshot is not None:
+            arg_vals, aux_vals, key = _snapshot
+        else:
+            arg_vals, aux_vals = self._gather()
+            key = self._next_key()
+        grad_args = {n: arg_vals[n] for n in self._grad_names}
+        other_args = {n: v for n, v in arg_vals.items()
+                      if n not in self._grad_names}
+        heads = _norm_head_grads(out_grads, len(self._output_names))
+        outs, aux_up, grads = self._jit_fwd_bwd(
+            grad_args, other_args, aux_vals, key, heads)
+        for name, val in aux_up.items():
+            self.aux_dict[name]._data = val
+        for name, g in grads.items():
+            dst = self.grad_dict.get(name)
+            if dst is None:
+                continue
+            if self._grad_req.get(name) == "add":
+                dst._data = dst._data + g.astype(dst.dtype)
+            else:
+                dst._data = g.astype(dst.dtype)
+        self.outputs = [_from_data(v, self._ctx) for v in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Reference Executor::Backward. Runs the fused fwd+vjp program (the
+        forward recompute lives in the same XLA program, so cost matches a
+        standard JAX grad step). Reuses the last training-forward's input/key
+        snapshot so the recompute is bit-identical to the forward the caller
+        observed (same dropout mask; aux updates idempotent)."""
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        self.forward_backward(out_grads=out_grads,
+                              _snapshot=getattr(self, "_fwd_snapshot", None))
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Reference Executor::Reshape: new executor sharing param arrays."""
+        shapes = {}
+        for name in self._arg_names:
+            if name in kwargs:
+                shapes[name] = kwargs[name]
+        new = Executor.simple_bind(self._symbol, self._ctx,
+                                   grad_req=self._grad_req,
+                                   shared_exec=self, **shapes)
+        return new
+
+    # -- monitor (reference graph_executor.h:71 monitor callback) --------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = (callback, monitor_all)
+
+    def _monitored_eval(self, arg_vals, aux_vals, is_train, key=None):
+        """Eager per-node evaluation invoking the monitor callback on every
+        node output (debug path; equivalent of the reference's per-op
+        monitor executed between engine pushes)."""
+        callback, monitor_all = self._monitor
+        nodes = self._symbol._topo_nodes()
+        env = {}
+        aux_updates = {}
+        if key is None:
+            key = self._next_key()
+        for seq, n in enumerate(nodes):
+            if n.is_var():
+                env[id(n)] = [arg_vals.get(n.name, aux_vals.get(n.name))]
+                if monitor_all:
+                    callback(n.name, _from_data(env[id(n)][0], self._ctx))
+                continue
+            op = get_op(n.op)
+            params = {k: v for k, v in n.attrs.items() if k != "__attrs__"}
+            if op.need_train_flag:
+                params["_is_train"] = bool(is_train)
+            if op.need_rng:
+                params["_rng_key"] = jax.random.fold_in(key, seq)
+            ins = [env[id(src)][oi] for src, oi in n.inputs]
+            outs = op.fcompute(params, *ins)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            n_out = op.n_out(params)
+            if op.mutate_aux:
+                for ai, new_val in zip(op.mutate_aux, outs[n_out:]):
+                    src, _ = n.inputs[ai]
+                    if src.is_var():
+                        aux_updates[src.name] = new_val
+                outs = outs[:n_out]
+            env[id(n)] = list(outs)
+            for i, o in enumerate(outs):
+                callback("%s_output%d" % (n.name, i) if len(outs) > 1
+                         else n.name + "_output", _from_data(o, self._ctx))
+        out_index = [(id(nd), i) for nd, i in self._symbol._outputs]
+        return [env[nid][i] for nid, i in out_index], aux_updates
+
+    # -- views -----------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = array.astype(self.arg_dict[name].dtype)
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the arguments" % name)
+        if aux_params is None:
+            return
+        for name, array in aux_params.items():
+            if name in self.aux_dict:
+                self.aux_dict[name][:] = array.astype(self.aux_dict[name].dtype)
+            elif not allow_extra_params:
+                raise ValueError("Find name %s that is not in the auxiliary states" % name)
+
+
+def _norm_req(grad_req, arg_names, kwargs):
+    if isinstance(grad_req, str):
+        return {n: grad_req for n in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(arg_names, grad_req))
+    if isinstance(grad_req, dict):
+        out = {n: "null" for n in arg_names}
+        out.update(grad_req)
+        return out
+    raise MXNetError("invalid grad_req")
+
+
+def _to_dict(arrs, names, what):
+    if isinstance(arrs, dict):
+        return dict(arrs)
+    if isinstance(arrs, (list, tuple)):
+        if len(arrs) != len(names):
+            raise MXNetError("Length of %s does not match number of names" % what)
+        return dict(zip(names, arrs))
+    raise MXNetError("%s must be list or dict" % what)
+
+
+def _norm_head_grads(out_grads, n):
+    if out_grads is None:
+        return tuple([None] * n)
+    if isinstance(out_grads, NDArray):
+        out_grads = [out_grads]
+    heads = []
+    for g in out_grads:
+        heads.append(g._data if isinstance(g, NDArray) else g)
+    while len(heads) < n:
+        heads.append(None)
+    return tuple(heads)

@@ -1,0 +1,14 @@
+"""Gluon: the imperative neural-network front-end
+(reference `python/mxnet/gluon/__init__.py`)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import contrib
+from .utils import split_and_load

@@ -1,0 +1,105 @@
+"""DataLoader (reference `python/mxnet/gluon/data/dataloader.py`).
+
+The reference forks multiprocessing workers that IPC batches through POSIX
+shared-memory NDArrays (`Context::kCPUShared`, cpu_shared_storage_manager.h).
+TPU-native: worker THREADS decode/transform (cv2/numpy release the GIL) and
+the assembled host batch transfers to device via PJRT asynchronously — no
+shm round-trip needed. num_workers keeps its reference meaning.
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d._data for d in data]), data[0].ctx)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        self._batchify_fn = batchify_fn if batchify_fn is not None else \
+            default_batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        batches = list(self._batch_sampler)
+        out_q = _queue.Queue(maxsize=2 * self._num_workers)
+        results = {}
+        next_idx = [0]
+        lock = threading.Lock()
+        job_q = _queue.Queue()
+        for i, b in enumerate(batches):
+            job_q.put((i, b))
+
+        def worker():
+            while True:
+                try:
+                    i, b = job_q.get_nowait()
+                except _queue.Empty:
+                    return
+                batch = self._batchify_fn([self._dataset[idx] for idx in b])
+                out_q.put((i, batch))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        received = 0
+        while received < len(batches):
+            i, batch = out_q.get()
+            results[i] = batch
+            received += 1
+            while next_idx[0] in results:
+                yield results.pop(next_idx[0])
+                next_idx[0] += 1
+        while next_idx[0] in results:
+            yield results.pop(next_idx[0])
+            next_idx[0] += 1
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,297 @@
+"""Vision datasets + transforms (reference
+`python/mxnet/gluon/data/vision/`): MNIST, FashionMNIST, CIFAR10/100,
+ImageRecordDataset, ImageFolderDataset, and the transforms module.
+
+No network egress in this environment: datasets read standard files from
+`root` (idx-ubyte for MNIST family, binary batches for CIFAR) and raise a
+clear error when absent.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import tarfile
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, array
+from .dataset import Dataset, RecordFileDataset
+from ..block import Block, HybridBlock
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "transforms"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+def _read_idx_file(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+class MNIST(_DownloadedDataset):
+    _files = {True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+              False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        img_path = os.path.join(self._root, img_name)
+        lbl_path = os.path.join(self._root, lbl_name)
+        for p in (img_path, lbl_path):
+            if not (os.path.exists(p) or os.path.exists(p + ".gz")):
+                raise MXNetError(
+                    "MNIST file %s not found (no network egress; place the "
+                    "idx-ubyte files under %s)" % (p, self._root))
+        if not os.path.exists(img_path):
+            img_path += ".gz"
+            lbl_path += ".gz"
+        data = _read_idx_file(img_path)
+        label = _read_idx_file(lbl_path)
+        self._data = array(data.reshape(-1, 28, 28, 1), dtype=np.uint8)
+        self._label = label.astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(-1, 3072 + 1)
+        return data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0].astype(np.int32)
+
+    def _get_data(self):
+        sub = os.path.join(self._root, "cifar-10-batches-bin")
+        base = sub if os.path.isdir(sub) else self._root
+        if self._train:
+            files = ["data_batch_%d.bin" % i for i in range(1, 6)]
+        else:
+            files = ["test_batch.bin"]
+        paths = [os.path.join(base, f) for f in files]
+        for p in paths:
+            if not os.path.exists(p):
+                raise MXNetError("CIFAR10 file %s not found (no network "
+                                 "egress; place binary batches under %s)"
+                                 % (p, self._root))
+        data, label = zip(*(self._read_batch(p) for p in paths))
+        self._data = array(np.concatenate(data), dtype=np.uint8)
+        self._label = np.concatenate(label)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root, train, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(-1, 3072 + 2)
+        return data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0 + self._fine_label].astype(np.int32)
+
+    def _get_data(self):
+        base = os.path.join(self._root, "cifar-100-binary")
+        base = base if os.path.isdir(base) else self._root
+        files = ["train.bin"] if self._train else ["test.bin"]
+        paths = [os.path.join(base, f) for f in files]
+        for p in paths:
+            if not os.path.exists(p):
+                raise MXNetError("CIFAR100 file %s not found" % p)
+        data, label = zip(*(self._read_batch(p) for p in paths))
+        self._data = array(np.concatenate(data), dtype=np.uint8)
+        self._label = np.concatenate(label)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ... import recordio, image
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        decoded = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(decoded, label)
+        return decoded, label
+
+
+class ImageFolderDataset(Dataset):
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from ... import image
+        img = image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class transforms:
+    """Reference gluon/data/vision/transforms.py (namespaced class-style)."""
+
+    class Compose(Block):
+        def __init__(self, transforms_list):
+            super().__init__()
+            self._transforms = transforms_list
+
+        def forward(self, x):
+            for t in self._transforms:
+                x = t(x) if not isinstance(t, Block) else t(x)
+            return x
+
+    class ToTensor(Block):
+        """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+        def __init__(self):
+            super().__init__()
+
+        def forward(self, x):
+            out = x.astype(np.float32) / 255.0
+            if out.ndim == 3:
+                return out.transpose((2, 0, 1))
+            return out.transpose((0, 3, 1, 2))
+
+    class Normalize(Block):
+        def __init__(self, mean, std):
+            super().__init__()
+            self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+            self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+        def forward(self, x):
+            return (x - array(self._mean)) / array(self._std)
+
+    class Cast(Block):
+        def __init__(self, dtype="float32"):
+            super().__init__()
+            self._dtype = dtype
+
+        def forward(self, x):
+            return x.astype(self._dtype)
+
+    class Resize(Block):
+        def __init__(self, size, keep_ratio=False, interpolation=1):
+            super().__init__()
+            self._size = size if isinstance(size, (list, tuple)) else (size, size)
+            self._interp = interpolation
+
+        def forward(self, x):
+            from ... import image
+            return image.imresize(x, self._size[0], self._size[1], self._interp)
+
+    class CenterCrop(Block):
+        def __init__(self, size, interpolation=1):
+            super().__init__()
+            self._size = size if isinstance(size, (list, tuple)) else (size, size)
+            self._interp = interpolation
+
+        def forward(self, x):
+            from ... import image
+            return image.center_crop(x, self._size, self._interp)[0]
+
+    class RandomResizedCrop(Block):
+        def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                     interpolation=1):
+            super().__init__()
+            self._size = size if isinstance(size, (list, tuple)) else (size, size)
+            self._scale = scale
+            self._ratio = ratio
+            self._interp = interpolation
+
+        def forward(self, x):
+            from ... import image
+            import random as pyrandom
+            h, w = x.shape[:2]
+            area = h * w
+            for _ in range(10):
+                target_area = pyrandom.uniform(*self._scale) * area
+                aspect = pyrandom.uniform(*self._ratio)
+                nw = int(round(np.sqrt(target_area * aspect)))
+                nh = int(round(np.sqrt(target_area / aspect)))
+                if nw <= w and nh <= h:
+                    x0 = pyrandom.randint(0, w - nw)
+                    y0 = pyrandom.randint(0, h - nh)
+                    return image.fixed_crop(x, x0, y0, nw, nh, self._size,
+                                            self._interp)
+            return image.center_crop(x, self._size, self._interp)[0]
+
+    class RandomFlipLeftRight(Block):
+        def __init__(self):
+            super().__init__()
+
+        def forward(self, x):
+            import random as pyrandom
+            if pyrandom.random() < 0.5:
+                return x.flip(axis=1)
+            return x

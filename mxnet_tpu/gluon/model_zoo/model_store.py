@@ -1,0 +1,36 @@
+"""Pretrained model store (reference
+`python/mxnet/gluon/model_zoo/model_store.py`).
+
+No network egress in this environment: pretrained weights resolve only from
+`root` (default ~/.mxnet/models) or `MXNET_TPU_MODEL_DIR`.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root=None):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    for candidate_root in [root, os.environ.get("MXNET_TPU_MODEL_DIR", "")]:
+        if not candidate_root:
+            continue
+        for fname in ("%s.params" % name, "%s-0000.params" % name):
+            path = os.path.join(candidate_root, fname)
+            if os.path.exists(path):
+                return path
+    raise MXNetError(
+        "Pretrained model file for %s not found under %s and no network "
+        "egress is available. Place the .params file there or set "
+        "MXNET_TPU_MODEL_DIR." % (name, root))
+
+
+def purge(root=None):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

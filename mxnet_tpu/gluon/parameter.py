@@ -1,0 +1,391 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity with reference `python/mxnet/gluon/parameter.py`. A Parameter owns one
+device NDArray (sharded over the ambient mesh when one is active) plus its
+gradient buffer; `deferred init` waits for the first forward to learn shapes,
+exactly like the reference.
+"""
+from __future__ import annotations
+
+import re
+import warnings
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc, Initializer, create as init_create
+from .. import initializer as init_mod
+from ..ndarray import NDArray, zeros as nd_zeros, array as nd_array
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._ctx = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req if differentiable else "null"
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            "Expected shape %s is incompatible with given shape %s." % (
+                str(new_shape), str(self._shape))
+        self._shape = tuple(new_shape)
+
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass." % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params" % self.name)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            warnings.warn("Parameter '%s' is already initialized, ignoring. "
+                          "Set force_reinit=True to re-initialize." % self.name,
+                          stacklevel=2)
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        if self._shape is None or np.prod(self._shape) <= 0:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError("Cannot initialize Parameter '%s' because it has "
+                             "invalid shape: %s." % (self.name, str(self._shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self._shape is not None and np.prod(self._shape) > 0, \
+            "Cannot initialize Parameter '%s' because it has invalid shape: %s." \
+            % (self.name, str(self._shape))
+        if data is None:
+            data = nd_zeros(self._shape, ctx=ctx, dtype=self.dtype)
+            effective = init if init is not None else (self.init or default_init)
+            if isinstance(effective, str):
+                effective = init_create(effective)
+            effective(InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx):
+        self._ctx = ctx
+        self._data = data
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = nd_zeros(self._data.shape, ctx=self._ctx, dtype=self._data.dtype)
+        from .. import autograd
+        autograd.mark_variables([self._data], [self._grad], self.grad_req)
+
+    def _load_init(self, data, ctx):
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                assert self_dim in (0, data_dim), \
+                    "Failed loading Parameter '%s' from saved params: shape " \
+                    "incompatibility, expected %s vs saved %s" % (
+                        self.name, str(self.shape), str(data.shape))
+            self.shape = tuple(i if i != 0 else j
+                               for i, j in zip(self.shape, data.shape))
+        if self.dtype is not None and np.dtype(self.dtype) != data.dtype:
+            data = data.astype(self.dtype)
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        if self._data is None:
+            self._deferred_init = ()
+            self._init_impl(data.as_in_context(ctx) if ctx else data,
+                            ctx or data.ctx)
+        else:
+            self.set_data(data)
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            init, ctx, default_init, _ = self._deferred_init
+            self._deferred_init = (init, ctx, default_init,
+                                   data if isinstance(data, NDArray) else
+                                   nd_array(data, ctx=ctx))
+            self._finish_deferred_init()
+            return
+        if not isinstance(data, NDArray):
+            data = nd_array(data, ctx=self._ctx)
+        self._data[:] = data
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' "
+                "because grad_req='null'" % self.name)
+        self._check_initialized()
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return [self._deferred_init[1]]
+            raise RuntimeError("Parameter '%s' has not been initialized" % self.name)
+        return [self._ctx]
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        self._grad[:] = 0
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            self._ctx = ctx[0]
+            self._init_grad()
+
+    def var(self):
+        from .. import symbol
+        if self._var is None:
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult, init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                from .. import autograd
+                autograd.mark_variables([self._data], [self._grad], self.grad_req)
+
+
+class Constant(Parameter):
+    """Reference gluon.Constant: non-trainable parameter with fixed value."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd_array(value)
+        self.value = value
+
+        class Init(Initializer):
+            def _init_weight(self, _, arr):
+                value.copyto(arr)
+        init_name = "Constant_{}_{}".format(name, id(self))
+        init_mod._INIT_REGISTRY[init_name.lower()] = Init
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=init_name)
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix namespacing (reference ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            "  " + repr(v) for v in self.values()))
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            inferred_shape.append(max(dim1, dim2))
+                        if matched:
+                            param._shape = tuple(inferred_shape)
+                            continue
+                    elif k == "dtype" and np.dtype(v) == np.dtype(existing):
+                        continue
+                    assert v is None or str(v) == str(existing), \
+                        "Cannot retrieve Parameter '%s' because desired " \
+                        "attribute does not match with stored for attribute " \
+                        "'%s': desired '%s' vs stored '%s'." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '{}'. Please specify value "
+                               "if you want to create a new constant.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name '%s'" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for param in self.values():
+            param.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for param in self.values():
+            param.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for param in self.values():
+            setattr(param, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with it" % (
+                        strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does not " \
+                    "start with it" % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        loaded = nd_load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (
+                        name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

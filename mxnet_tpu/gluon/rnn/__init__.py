@@ -1,0 +1,3 @@
+"""Gluon RNN package (reference `python/mxnet/gluon/rnn/`)."""
+from .rnn_cell import *
+from .rnn_layer import *

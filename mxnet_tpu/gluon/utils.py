@@ -1,0 +1,105 @@
+"""Gluon utilities (reference `python/mxnet/gluon/utils.py`)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            "Too many slices for data with shape %s. Arguments are "
+            "num_slice=%d and batch_axis=%d." % (str(data.shape), num_slice,
+                                                 batch_axis))
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1
+                  else data[i * step:size] for i in range(num_slice)]
+    else:
+        slices = [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+                  if i < num_slice - 1
+                  else data.slice_axis(batch_axis, i * step, size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Reference utils.py split_and_load. On TPU, prefer a mesh-sharded
+    batch (`parallel.split_and_load_sharded`) — this per-device list form is
+    kept for reference API compatibility."""
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescales NDArrays so that the sum of their 2-norm is smaller than
+    max_norm (reference utils.py clip_global_norm)."""
+
+    def _norm(array):
+        if array.stype == "default":
+            x = array.reshape((-1,))
+            return x.dot(x)
+        return array.norm().square()
+
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = sum(_norm(arr).as_in_context(ctx).asscalar() for arr in arrays)
+    total_norm = np.sqrt(total_norm)
+    if not np.isfinite(total_norm):
+        import warnings
+        warnings.warn(UserWarning("nan or inf is detected. Clipping results "
+                                  "will be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Reference utils.py download. This environment has no egress; the
+    function resolves only to local files or MXNET_TPU_DATA_DIR caches."""
+    fname = url.split("/")[-1]
+    if path is None:
+        path = fname
+    if os.path.isdir(path):
+        path = os.path.join(path, fname)
+    if os.path.exists(path) and not overwrite and \
+            (not sha1_hash or check_sha1(path, sha1_hash)):
+        return path
+    cache = os.environ.get("MXNET_TPU_DATA_DIR", "")
+    cached = os.path.join(cache, fname)
+    if cache and os.path.exists(cached):
+        return cached
+    raise MXNetError("download(%s): no network egress available; place the "
+                     "file at %s or set MXNET_TPU_DATA_DIR" % (url, path))

@@ -1,0 +1,403 @@
+"""Imperative image API (reference `python/mxnet/image/`, 2,213 LoC).
+
+imdecode/imresize/augmenters/ImageIter. Decode runs on host CPU (OpenCV like
+the reference); normalisation/augmentation arithmetic can run on device via
+NDArray ops.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+from .codec import imdecode_np, imencode
+
+__all__ = ["imdecode", "imread", "imresize", "fixed_crop", "random_crop",
+           "center_crop", "color_normalize", "resize_short", "scale_down",
+           "ImageIter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "LightingAug", "ColorJitterAug",
+           "CreateAugmenter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Reference image.imdecode: returns HWC RGB NDArray."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = imdecode_np(buf, iscolor=flag, to_rgb=to_rgb)
+    return array(img, dtype=np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    try:
+        import cv2
+        interp_map = {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR,
+                      2: cv2.INTER_CUBIC, 3: cv2.INTER_AREA,
+                      4: cv2.INTER_LANCZOS4}
+        out = cv2.resize(src.asnumpy(), (w, h), interpolation=interp_map.get(interp, 1))
+    except ImportError:  # pragma: no cover
+        from PIL import Image
+        out = np.asarray(Image.fromarray(src.asnumpy()).resize((w, h)))
+    return array(out, dtype=out.dtype)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp=interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = array(mean) if mean is not None and not isinstance(mean, NDArray) else mean
+        self.std = array(std) if std is not None and not isinstance(std, NDArray) else std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src.asnumpy() * self.coef).sum() * (3.0 / src.size)
+        return src * alpha + (1.0 - alpha) * float(gray)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray_np = (src.asnumpy() * self.coef).sum(axis=2, keepdims=True)
+        gray = array(gray_np * (1.0 - alpha))
+        return src * alpha + gray
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting jitter (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval)
+        self.eigvec = np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return src + array(rgb.astype(np.float32))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = []
+        if brightness > 0:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Reference image.py CreateAugmenter."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Reference image.py ImageIter: .rec or .lst based image iterator with
+    augmentation; yields NCHW float batches."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        from ..io import DataDesc, DataBatch
+        from .. import recordio as rio
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self._data_name = data_name
+        self._label_name = label_name
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(data_shape, **{
+            k: v for k, v in kwargs.items()
+            if k in ("resize", "rand_crop", "rand_resize", "rand_mirror", "mean",
+                     "std", "brightness", "contrast", "saturation", "pca_noise",
+                     "inter_method")})
+        self.record = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.record = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r") \
+                if os.path.exists(idx_path) else rio.MXRecordIO(path_imgrec, "r")
+            if hasattr(self.record, "keys") and self.record.keys:
+                self.seq = list(self.record.keys)
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], dtype=np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        elif imglist is not None:
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (np.array(label, np.float32).reshape(-1), fname)
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        else:
+            raise MXNetError("need path_imgrec, path_imglist or imglist")
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name, (batch_size, label_width)
+                                       if label_width > 1 else (batch_size,))]
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq:
+            pyrandom.shuffle(self.seq)
+        if self.record is not None:
+            self.record.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        from .. import recordio as rio
+        if self.record is not None:
+            if self.seq:
+                if self.cur >= len(self.seq):
+                    raise StopIteration
+                idx = self.seq[self.cur]
+                self.cur += 1
+                s = self.record.read_idx(idx)
+            else:
+                s = self.record.read()
+                if s is None:
+                    raise StopIteration
+            header, img = rio.unpack(s)
+            label = header.label
+            return label, img
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            img = f.read()
+        return label, img
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from ..io import DataBatch
+        from ..ndarray import zeros as nd_zeros
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), dtype=np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                label, s = self.next_sample()
+                img = imdecode(s)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy() if isinstance(img, NDArray) else img
+                batch_data[i] = np.transpose(arr, (2, 0, 1))
+                batch_label[i] = np.asarray(label, np.float32).reshape(-1)[:self.label_width]
+                i += 1
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+        lab = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch(data=[array(batch_data)], label=[array(lab)], pad=pad)

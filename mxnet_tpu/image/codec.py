@@ -1,0 +1,50 @@
+"""JPEG/PNG encode/decode (reference uses OpenCV in `src/io/image_recordio.h`)."""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import cv2
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    _HAS_CV2 = False
+
+try:
+    from PIL import Image
+    import io as _pyio
+    _HAS_PIL = True
+except Exception:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def imencode(img, img_fmt=".jpg", quality=95):
+    """img: HWC uint8 BGR (cv2 convention, matching the reference)."""
+    if _HAS_CV2:
+        params = [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg") \
+            else [cv2.IMWRITE_PNG_COMPRESSION, quality]
+        ok, buf = cv2.imencode(img_fmt, img, params)
+        assert ok, "imencode failed"
+        return buf.tobytes()
+    if _HAS_PIL:
+        b = _pyio.BytesIO()
+        Image.fromarray(img[..., ::-1]).save(b, format="JPEG" if "jp" in img_fmt else "PNG",
+                                             quality=quality)
+        return b.getvalue()
+    raise RuntimeError("no image codec available (cv2/PIL)")
+
+
+def imdecode_np(buf, iscolor=1, to_rgb=False):
+    """Decode to HWC uint8. BGR by default (reference cv2 convention)."""
+    data = np.frombuffer(buf, dtype=np.uint8)
+    if _HAS_CV2:
+        flag = cv2.IMREAD_COLOR if iscolor != 0 else cv2.IMREAD_GRAYSCALE
+        img = cv2.imdecode(data, flag)
+        if img is None:
+            raise ValueError("cannot decode image")
+        if to_rgb and img.ndim == 3:
+            img = img[..., ::-1]
+        return img
+    if _HAS_PIL:
+        img = np.asarray(Image.open(_pyio.BytesIO(buf)).convert("RGB"))
+        return img if to_rgb else img[..., ::-1]
+    raise RuntimeError("no image codec available (cv2/PIL)")

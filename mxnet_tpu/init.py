@@ -1,0 +1,4 @@
+"""`mx.init` alias namespace (reference exposes initializers under mx.init)."""
+from .initializer import (InitDesc, Initializer, Zero, One, Constant, Uniform,
+                          Normal, Orthogonal, Xavier, MSRAPrelu, Bilinear,
+                          LSTMBias, Mixed, Load, register, create)

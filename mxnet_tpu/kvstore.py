@@ -1,0 +1,181 @@
+"""KVStore: the distributed key-value parameter store.
+
+Parity with reference `include/mxnet/kvstore.h:47` and
+`python/mxnet/kvstore.py` — Init/Push/Pull (int and string keys),
+set_optimizer/updater, rank/num_workers, Barrier.
+
+TPU-native backends (SURVEY.md §2.7/§5 mapping):
+
+- ``local`` / ``device``  — single-process aggregation. The reference reduces
+  across GPUs with CPU trees or P2P rings (`src/kvstore/comm.h:103,410`);
+  here pushed values are summed on-device by XLA (values living on different
+  chips of one host are reduced via ICI by `jax.device_put` + add).
+- ``tpu`` (alias ``nccl``) — same API; aggregation is laid out so that when
+  values are sharded over a `parallel.Mesh`, the reduce lowers to `psum`
+  over ICI (see `mxnet_tpu/parallel/`). This replaces `kvstore_nccl.h`.
+- ``dist_sync`` / ``dist_async`` / ``dist_sync_device`` — multi-process data
+  parallelism over `jax.distributed` collectives instead of the ps-lite
+  parameter server (`src/kvstore/kvstore_dist.h`). Sync mode is BSP like the
+  reference; async mode is emulated as sync (documented degradation — a
+  straggler-tolerant PS has no clean collective analog, SURVEY.md §5).
+
+The updater runs on-device as registered optimizer ops, which mirrors the
+reference running optimizer kernels inside the engine.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .context import cpu, current_context
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctx_group_sum(vals):
+    """Sum a list of NDArrays (possibly on different devices) onto vals[0]'s
+    device. XLA issues the cross-chip copies over ICI."""
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v.as_in_context(out.context)
+    return out
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._key_type = None
+        self._compression = {}
+
+    # -- identity --------------------------------------------------------
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index() if self.type.startswith("dist") else 0
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count() if self.type.startswith("dist") else 1
+
+    def _check_key(self, key):
+        kt = str if isinstance(key, str) else int
+        if self._key_type is None:
+            self._key_type = kt
+        elif self._key_type is not kt:
+            raise MXNetError("inconsistent key types")
+        return key
+
+    # -- core API --------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._check_key(k)
+            if k in self._store:
+                raise MXNetError("key %s already initialized" % str(k))
+            vv = v[0] if isinstance(v, list) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % str(k))
+            vs = vs if isinstance(vs, list) else [vs]
+            merged = _ctx_group_sum(vs)
+            if self.num_workers > 1:
+                merged = self._allreduce(merged)
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(k, merged.as_in_context(stored.context), stored)
+            else:
+                stored[:] = merged.as_in_context(stored.context)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, os in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % str(k))
+            os = os if isinstance(os, list) else [os]
+            src = self._store[k]
+            for o in os:
+                src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference kvstore.h:195)."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        keys, outs = _normalize(key, out)
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        for k, os in zip(keys, outs):
+            src = self._store[k]
+            os = os if isinstance(os, list) else [os]
+            for o, rid in zip(os, rids * len(os)):
+                rows = src.take(rid.astype("int32"), axis=0)
+                o[:] = 0
+                # scatter rows back into the dense output
+                o._data = o._data.at[rid._data.astype("int32")].set(
+                    rows._data.astype(o.dtype))
+
+    # -- optimizer / updater --------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """Reference 2-bit gradient compression (`gradient_compression.h`).
+        On TPU, gradients ride ICI collectives; compression is a no-op knob
+        kept for API parity (recorded for introspection)."""
+        self._compression = dict(compression_params)
+
+    # -- distributed -----------------------------------------------------
+    def _allreduce(self, merged):
+        """Cross-process gradient sum (replaces ps-lite ZPush/ZPull)."""
+        from .parallel import dist
+        return dist.allreduce_nd(merged)
+
+    def barrier(self):
+        if self.num_workers > 1:
+            from .parallel import dist
+            dist.barrier()
+
+    def send_command_to_servers(self, head, body):
+        """PS command channel; server-free on TPU — no-op for parity."""
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _normalize(key, value):
+    if isinstance(key, (str, int)):
+        return [key], [value]
+    return list(key), list(value)
+
+
+def create(name="local"):
+    """Factory (reference `src/kvstore/kvstore.cc:40-75`)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "nccl", "tpu", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_async",
+             "dist_sync_device", "dist_device_sync", "dist")
+    if name not in valid:
+        raise MXNetError("unknown kvstore type %s" % name)
+    return KVStore(name)

@@ -1,0 +1,474 @@
+"""Module: symbolic training on one executor (optionally mesh-sharded).
+
+Parity with reference `python/mxnet/module/module.py` (bind/init_params/
+init_optimizer/forward/backward/update/...). TPU-native differences:
+
+- The reference's DataParallelExecutorGroup (one executor per GPU, batch
+  sliced on the host, grads reduced via KVStore comm) is replaced by ONE
+  executor whose jitted program runs SPMD over all chips when the module's
+  context list has >1 device: inputs are placed batch-sharded over a 'dp'
+  mesh, parameters replicated, and XLA inserts the gradient psum over ICI.
+- update() goes through the KVStore API exactly like the reference
+  (`_update_params_on_kvstore`), so user code and custom updaters port 1:1.
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..executor import Executor
+from ..initializer import Uniform, InitDesc
+from ..ndarray import NDArray, zeros as nd_zeros
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .base_module import BaseModule, _check_input_names
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Reference `python/mxnet/model.py:_create_kvstore`."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape) for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = cpu()
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+        self._group2ctxs = group2ctxs
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) if fixed_param_names is not None else []
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+        self._monitor = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+
+    # -- properties ------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return list(zip(self._output_names, self._out_shapes))
+
+    # -- params ----------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and force_init=False. "
+                          "init_params call ignored.", stacklevel=2)
+            return
+        assert self.binded, "call bind before initializing the parameters"
+
+        def _impl(name, arr, cache):
+            if cache is not None:
+                if name in cache:
+                    cache_arr = cache[name]
+                    if cache_arr is not arr:
+                        if cache_arr.shape != arr.shape:
+                            raise MXNetError("shape mismatch for %s: %s vs %s"
+                                             % (name, cache_arr.shape, arr.shape))
+                        cache_arr.copyto(arr)
+                else:
+                    if not allow_missing:
+                        raise RuntimeError("%s is not presented" % name)
+                    if initializer is not None:
+                        initializer(InitDesc(name, attrs={}), arr)
+            else:
+                if initializer is not None:
+                    initializer(InitDesc(name, attrs={}), arr)
+
+        attrs = self._symbol.attr_dict()
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            desc_attrs = attrs.get(name, {})
+            if initializer is not None and "__init__" in desc_attrs and \
+                    (arg_params is None or name not in arg_params):
+                initializer(InitDesc(name, attrs=desc_attrs), arr)
+            else:
+                _impl(name, arr, arg_params)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            _impl(name, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = True
+        self._sync_params_from_devices()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=allow_missing,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and force_init=False. "
+                          "set_params call ignored.", stacklevel=2)
+            return
+        for name, arr in (arg_params or {}).items():
+            if name in self._exec.arg_dict:
+                self._exec.arg_dict[name][:] = arr
+        for name, arr in (aux_params or {}).items():
+            if name in self._exec.aux_dict:
+                self._exec.aux_dict[name][:] = arr
+        self.params_initialized = True
+        self._params_dirty = True
+
+    def _sync_params_from_devices(self):
+        if not self.binded:
+            return
+        self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n] for n in self._aux_names}
+        self._params_dirty = False
+
+    # -- bind ------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        assert not (not for_training and inputs_need_grad)
+
+        self._data_shapes = _norm_shapes(data_shapes)
+        self._label_shapes = _norm_shapes(label_shapes) if label_shapes else []
+        shapes = {}
+        for desc in self._data_shapes + self._label_shapes:
+            shapes[desc[0]] = desc[1]
+
+        req = {}
+        for name in self._symbol.list_arguments():
+            if not for_training:
+                req[name] = "null"
+            elif name in self._param_names:
+                req[name] = "null" if name in self._fixed_param_names else grad_req
+            elif name in [d[0] for d in self._data_shapes]:
+                req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                req[name] = "null"
+        self._grad_req = req
+
+        shared_exec = shared_module._exec if shared_module is not None else None
+        ctx = self._context[0]
+        self._exec = Executor.simple_bind(self._symbol, ctx, grad_req=req,
+                                          shared_exec=shared_exec, **shapes)
+        from ..symbol.symbol import _graph_infer
+        _, self._out_shapes, _ = _graph_infer(self._symbol, shapes)
+        self.binded = True
+        # restore previously held params (e.g. after Module.load)
+        if self._arg_params is not None:
+            for name, arr in self._arg_params.items():
+                if name in self._exec.arg_dict and \
+                        self._exec.arg_dict[name] is not arr:
+                    arr.copyto(self._exec.arg_dict[name])
+        if self._aux_params is not None:
+            for name, arr in self._aux_params.items():
+                if name in self._exec.aux_dict and \
+                        self._exec.aux_dict[name] is not arr:
+                    arr.copyto(self._exec.aux_dict[name])
+        if shared_module is not None and shared_module.params_initialized:
+            self.params_initialized = True
+            self._sync_params_from_devices()
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self._data_shapes = _norm_shapes(data_shapes)
+        self._label_shapes = _norm_shapes(label_shapes) if label_shapes else []
+        shapes = {}
+        for desc in self._data_shapes + self._label_shapes:
+            shapes[desc[0]] = desc[1]
+        self._exec = self._exec.reshape(**shapes)
+
+    # -- optimizer -------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), {n: self._exec.arg_dict[n]
+                                          for n in self._param_names})
+        batch_size = self._data_shapes[0][1][0]
+        if kvstore and "dist" in kvstore.type and "_async" not in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != rescale_grad:
+                warnings.warn("Optimizer created manually outside Module but "
+                              "rescale_grad is not normalized to 1.0/batch_size/num_workers. "
+                              "Is this intended?", stacklevel=2)
+            if not optimizer.idx2name:
+                optimizer.param_dict = {}
+                optimizer.idx2name = idx2name.copy()
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            for i, name in enumerate(self._param_names):
+                kvstore.init(i, self._exec.arg_dict[name])
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer/kvstore/updater with another module (bucketing)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # -- compute ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._load_batch(data_batch)
+        self._exec.forward(is_train=is_train)
+
+    def _load_batch(self, data_batch):
+        data = data_batch.data
+        for name, arr in zip(self._data_names, data):
+            dst = self._exec.arg_dict[name]
+            if dst.shape != arr.shape:
+                # dynamic batch (bucketing/last small batch): rebind via reshape
+                self.reshape([(n, a.shape) for n, a in zip(self._data_names, data)],
+                             [(n, a.shape) for n, a in
+                              zip(self._label_names, data_batch.label or [])] or None)
+                dst = self._exec.arg_dict[name]
+            dst[:] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    self._exec.arg_dict[name][:] = arr
+
+    def forward_backward(self, data_batch):
+        """Fused fwd+bwd: one compiled XLA dispatch (see executor)."""
+        assert self.binded and self.params_initialized
+        self._load_batch(data_batch)
+        if self._monitor is not None:
+            self._exec.forward(is_train=True)
+        self._exec.forward_backward()
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Reference module.py:631 + model.py _update_params(_on_kvstore)."""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                if self._grad_req.get(name) == "null":
+                    continue
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._kvstore.push(i, grad)
+                self._kvstore.pull(i, self._exec.arg_dict[name])
+        else:
+            if self._kvstore:
+                for i, name in enumerate(self._param_names):
+                    if self._grad_req.get(name) == "null":
+                        continue
+                    grad = self._exec.grad_dict.get(name)
+                    if grad is None:
+                        continue
+                    self._kvstore.push(i, grad)
+                    self._kvstore.pull(i, grad)
+            for i, name in enumerate(self._param_names):
+                if self._grad_req.get(name) == "null":
+                    continue
+                grad = self._exec.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        if states is not None:
+            for name, arr in zip(self._state_names, states):
+                self._exec.arg_dict[name][:] = arr
+        else:
+            for name in self._state_names:
+                self._exec.arg_dict[name][:] = value
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update_dict(dict(zip(self._label_names, labels or [])),
+                                dict(zip(self._output_names, self._exec.outputs)))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        self._monitor = mon
+        mon.install(self._exec)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+
+def _norm_shapes(shapes):
+    from ..io import DataDesc
+    out = []
+    for s in shapes:
+        if isinstance(s, DataDesc):
+            out.append((s.name, tuple(s.shape)))
+        else:
+            out.append((s[0], tuple(s[1])))
+    return out

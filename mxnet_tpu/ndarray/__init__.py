@@ -1,0 +1,24 @@
+"""`mx.nd` namespace (reference `python/mxnet/ndarray/`)."""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concatenate, moveaxis, waitall)
+from .utils import save, load
+from . import random
+from . import sparse
+from . import register as _register
+from .register import populate as _populate
+
+# generate module-level functions for every registered operator
+_populate(globals())
+
+# a few reference-API conveniences
+onehot_encode = globals().get("one_hot")
+
+
+def zeros_like(a, **kw):
+    from ..ops.invoke import invoke
+    return invoke("zeros_like", [a], kw)
+
+
+def ones_like(a, **kw):
+    from ..ops.invoke import invoke
+    return invoke("ones_like", [a], kw)

@@ -1,0 +1,76 @@
+"""Auto-generate module-level NDArray op functions from the registry.
+
+Parity with reference `python/mxnet/ndarray/register.py`, which generates
+Python bindings from the C-API op registry at import time. Here generation is
+pure Python: every visible registered op becomes a function
+``op(*tensor_inputs, out=None, ctx=None, **attrs)``.
+"""
+from __future__ import annotations
+
+from ..ops.registry import _OPS
+from ..ops.invoke import invoke
+from .ndarray import NDArray
+
+__all__ = ["populate"]
+
+
+# Ops commonly called with trailing positional scalar attributes (reference
+# generated signatures put these after the tensor inputs).
+_POS_PARAMS = {
+    "one_hot": ("depth", "on_value", "off_value"),
+    "clip": ("a_min", "a_max"),
+    "expand_dims": ("axis",),
+    "repeat": ("repeats", "axis"),
+    "tile": ("reps",),
+    "flip": ("axis",),
+    "reverse": ("axis",),
+    "smooth_l1": ("scalar",),
+    "diag": ("k",),
+    "_plus_scalar": ("scalar",), "_minus_scalar": ("scalar",),
+    "_mul_scalar": ("scalar",), "_div_scalar": ("scalar",),
+    "_power_scalar": ("scalar",),
+}
+
+
+def _make_fn(name):
+    pos_params = _POS_PARAMS.get(name, ())
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        ctx = kwargs.pop("ctx", None)
+        name_attr = kwargs.pop("name", None)
+        inputs = []
+        extra_pos = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                inputs.extend(a)
+            else:
+                extra_pos.append(a)
+        if extra_pos:
+            if len(extra_pos) > len(pos_params):
+                raise TypeError("%s: too many positional attribute args (%d)"
+                                % (name, len(extra_pos)))
+            for pname, pval in zip(pos_params, extra_pos):
+                kwargs.setdefault(pname, pval)
+        # NDArray-valued keyword args (e.g. data=..., weight=...) appended in
+        # insertion order after positional inputs.
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                inputs.append(v)
+            else:
+                attrs[k] = v
+        return invoke(name, inputs, attrs, out=out, ctx=ctx, name=name_attr)
+
+    fn.__name__ = name
+    return fn
+
+
+def populate(namespace):
+    for name, op in list(_OPS.items()):
+        if not op.visible:
+            continue
+        if name not in namespace:
+            namespace[name] = _make_fn(name)

@@ -1,0 +1,195 @@
+"""Sparse NDArrays: RowSparse and CSR.
+
+Parity with reference `python/mxnet/ndarray/sparse.py` and the C++ storage
+types (`include/mxnet/ndarray.h:61-66`). TPU note (SURVEY.md §7 hard-part 3):
+TPUs have no native sparse kernels — aux index structures live as dense
+int arrays and sparse math lowers to gather/scatter + dense MXU ops, which is
+the idiomatic XLA formulation. The API (stype, indices/indptr/data,
+cast_storage, sparse dot, retain) matches the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np
+from ..context import current_context
+from .ndarray import NDArray, array as nd_array, zeros as nd_zeros
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "zeros", "empty",
+           "retain", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Sparse wrapper: keeps the dense payload (for compute) plus the sparse
+    aux structure (for IO/comm); `_data` stays the dense jax array so every
+    registered op works unchanged."""
+
+    __slots__ = ("_aux",)
+
+    def __init__(self, data, ctx=None, aux=None):
+        super().__init__(data, ctx)
+        self._aux = aux or {}
+
+    def __repr__(self):
+        return "\n%s\n<%s %s @%s>" % (str(self.asnumpy()),
+                                      self.__class__.__name__,
+                                      "x".join(map(str, self.shape)), self.ctx)
+
+    def todense(self):
+        return NDArray(self._data, self._ctx)
+
+    tostype_dense = todense
+
+
+class CSRNDArray(BaseSparseNDArray):
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return nd_array(self._aux["indices"], dtype=np.int64)
+
+    @property
+    def indptr(self):
+        return nd_array(self._aux["indptr"], dtype=np.int64)
+
+    @property
+    def data(self):
+        return nd_array(self._aux["values"])
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return nd_array(self._aux["indices"], dtype=np.int64)
+
+    @property
+    def data(self):
+        return nd_array(self._aux["values"])
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+
+def _dense_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create CSRNDArray from (data, indices, indptr) or dense source."""
+    dtype = dtype_np(dtype) if dtype else None
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _dense_np(data)
+        indices = _dense_np(indices).astype(np.int64)
+        indptr = _dense_np(indptr).astype(np.int64)
+        assert shape is not None
+        dense = np.zeros(shape, dtype=dtype or data.dtype)
+        for r in range(shape[0]):
+            for k in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[k]] = data[k]
+        return CSRNDArray(jnp.asarray(dense), ctx or current_context(),
+                          {"values": data, "indices": indices, "indptr": indptr})
+    dense = _dense_np(arg1)
+    if dtype:
+        dense = dense.astype(dtype)
+    return _dense_to_csr(dense, ctx)
+
+
+def _dense_to_csr(dense, ctx=None):
+    indptr = [0]
+    indices = []
+    values = []
+    for row in dense:
+        nz = np.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        values.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(jnp.asarray(dense), ctx or current_context(),
+                      {"values": np.asarray(values, dense.dtype),
+                       "indices": np.asarray(indices, np.int64),
+                       "indptr": np.asarray(indptr, np.int64)})
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    dtype = dtype_np(dtype) if dtype else None
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _dense_np(data)
+        indices = _dense_np(indices).astype(np.int64)
+        assert shape is not None
+        dense = np.zeros(shape, dtype=dtype or data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense), ctx or current_context(),
+                                {"values": data, "indices": indices})
+    dense = _dense_np(arg1)
+    if dtype:
+        dense = dense.astype(dtype)
+    return _dense_to_row_sparse(dense, ctx)
+
+
+def _dense_to_row_sparse(dense, ctx=None):
+    nz_rows = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense), ctx or current_context(),
+                            {"values": dense[nz_rows],
+                             "indices": nz_rows.astype(np.int64)})
+
+
+def cast_storage(arr, stype):
+    """Reference `tensor/cast_storage-inl.h` dense<->sparse conversion."""
+    if stype == arr.stype:
+        return arr
+    dense = arr.asnumpy()
+    if stype == "default":
+        return NDArray(jnp.asarray(dense), arr.ctx)
+    if stype == "csr":
+        return _dense_to_csr(dense, arr.ctx)
+    if stype == "row_sparse":
+        return _dense_to_row_sparse(dense, arr.ctx)
+    raise MXNetError("unknown storage type " + stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "default":
+        return nd_zeros(shape, ctx=ctx, dtype=dtype)
+    base = np.zeros(shape, dtype_np(dtype))
+    if stype == "csr":
+        return _dense_to_csr(base, ctx)
+    return _dense_to_row_sparse(base, ctx)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx, dtype)
+
+
+def retain(arr, row_ids):
+    """Reference sparse_retain: keep only the given rows."""
+    rid = row_ids.asnumpy().astype(np.int64) if isinstance(row_ids, NDArray) \
+        else np.asarray(row_ids, np.int64)
+    dense = arr.asnumpy()
+    out = np.zeros_like(dense)
+    out[rid] = dense[rid]
+    return _dense_to_row_sparse(out, arr.ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference tensor/dot-inl.h): lowers to dense MXU
+    matmul — on TPU the dense path through gather is the fast one."""
+    from ..ops.invoke import invoke
+    return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a,
+                                      "transpose_b": transpose_b})

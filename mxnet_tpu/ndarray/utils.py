@@ -1,0 +1,47 @@
+"""NDArray save/load (reference `python/mxnet/ndarray/utils.py:149,222` and
+the C++ serializer `src/ndarray/ndarray.cc:1596,1709,1794`).
+
+Format: a `.npz`-based container (portable, fast) with the reference's
+dict/list semantics: saving a list stores keys ``arr_0..arr_n``; loading
+returns a list or a dict depending on how it was saved.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load"]
+
+_LIST_KEY = "__mx_tpu_list__"
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    payload = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise MXNetError("save only supports NDArray values")
+            payload[k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        payload[_LIST_KEY] = np.array(len(data))
+        for i, v in enumerate(data):
+            payload["arr_%d" % i] = v.asnumpy()
+    else:
+        raise MXNetError("data needs to either be a NDArray, dict of str, "
+                         "NDArray pairs or a list of NDarrays.")
+    with open(fname, "wb") as f:
+        np.savez(f, **payload)
+
+
+def load(fname, ctx=None):
+    with np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if _LIST_KEY in keys:
+            n = int(npz[_LIST_KEY])
+            return [array(npz["arr_%d" % i], ctx=ctx, dtype=npz["arr_%d" % i].dtype)
+                    for i in range(n)]
+        return {k: array(npz[k], ctx=ctx, dtype=npz[k].dtype) for k in keys}

@@ -1,0 +1,157 @@
+"""Contrib / detection operators (first tranche).
+
+Parity targets: reference `src/operator/contrib/` (bounding-box ops,
+MultiBox SSD suite, ROIPooling, FFT, count_sketch, quadratic) and the
+fork-specific detection ops. Expanded over rounds; see ops/detection.py for
+the SSD/RCNN suite.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(params, x):
+    a, b, c = params.get("a", 0.0), params.get("b", 0.0), params.get("c", 0.0)
+    return (a * x * x + b * x + c,)
+
+
+@register("_contrib_fft", aliases=("fft",))
+def _fft(params, x):
+    out = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    return (jnp.stack([out.real, out.imag], axis=-1).reshape(
+        x.shape[:-1] + (2 * x.shape[-1],)).astype(jnp.float32),)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def _ifft(params, x):
+    n = x.shape[-1] // 2
+    comp = x.reshape(x.shape[:-1] + (n, 2))
+    out = jnp.fft.ifft(comp[..., 0] + 1j * comp[..., 1], axis=-1)
+    return ((out.real * n).astype(jnp.float32),)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def _count_sketch(params, data, h, s):
+    out_dim = params["out_dim"]
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.reshape(-1)
+    contrib = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return (out.at[:, idx].add(contrib),)
+
+
+def box_iou_xyxy(a, b):
+    """IoU of two corner-format box sets: a (..., N, 4), b (..., M, 4)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:4], b[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(b[..., 3] - b[..., 1], 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def _box_iou(params, lhs, rhs):
+    fmt = params.get("format", "corner")
+    a, b = lhs, rhs
+    if fmt == "center":
+        a = jnp.concatenate([a[..., :2] - a[..., 2:4] / 2,
+                             a[..., :2] + a[..., 2:4] / 2], axis=-1)
+        b = jnp.concatenate([b[..., :2] - b[..., 2:4] / 2,
+                             b[..., :2] + b[..., 2:4] / 2], axis=-1)
+    return (box_iou_xyxy(a, b),)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def _box_nms(params, data):
+    """Greedy NMS over (B, N, K>=6) [id, score, x1,y1,x2,y2,...] boxes.
+
+    Implemented as a fori_loop over a score-sorted copy — static shapes,
+    TPU-friendly (reference contrib/bounding_box-inl.h).
+    """
+    thresh = params.get("overlap_thresh", 0.5)
+    vthresh = params.get("valid_thresh", 0.0)
+    topk = params.get("topk", -1)
+    coord = params.get("coord_start", 2)
+    score_i = params.get("score_index", 1)
+    id_i = params.get("id_index", -1)
+    force = params.get("force_suppress", False)
+    x = data
+    squeeze = False
+    if x.ndim == 2:
+        x = x[None]
+        squeeze = True
+    B, N, K = x.shape
+    scores = x[..., score_i]
+    order = jnp.argsort(-scores, axis=1)
+    xs = jnp.take_along_axis(x, order[..., None], axis=1)
+    boxes = xs[..., coord:coord + 4]
+    ious = box_iou_xyxy(boxes, boxes)
+    valid = xs[..., score_i] > vthresh
+    if topk > 0:
+        valid = valid & (jnp.arange(N)[None, :] < topk)
+    if not force and id_i >= 0:
+        same = xs[..., id_i][:, :, None] == xs[..., id_i][:, None, :]
+        ious = jnp.where(same, ious, 0.0)
+
+    def body(i, keep):
+        iou_i = lax.dynamic_index_in_dim(ious, i, axis=1, keepdims=False)
+        keep_i = lax.dynamic_index_in_dim(keep, i, axis=1, keepdims=True)
+        sup = (iou_i > thresh) & (jnp.arange(N)[None, :] > i) & keep_i
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, N, body, valid)
+    out = jnp.where(keep[..., None], xs, -1.0)
+    if squeeze:
+        out = out[0]
+    return (out,)
+
+
+@register("ROIPooling")
+def _roi_pooling(params, data, rois):
+    """Reference src/operator/roi_pooling.cc. data (B,C,H,W),
+    rois (R,5) [batch_idx, x1, y1, x2, y2] in image coords."""
+    ph, pw = params["pooled_size"]
+    spatial_scale = params.get("spatial_scale", 1.0)
+    B, C, H, W = data.shape
+
+    def pool_one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bi]  # (C,H,W)
+        ys = jnp.arange(H)
+        xs_ = jnp.arange(W)
+
+        def cell(iy, ix):
+            hstart = y1 + (iy * rh) // ph
+            hend = y1 + ((iy + 1) * rh + ph - 1) // ph
+            wstart = x1 + (ix * rw) // pw
+            wend = x1 + ((ix + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs_[None, :] >= wstart) & (xs_[None, :] < wend) &
+                    (ys[:, None] >= 0) & (ys[:, None] < H) &
+                    (xs_[None, :] >= 0) & (xs_[None, :] < W))
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            m = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)
+
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        grid = jax.vmap(lambda y: jax.vmap(lambda x_: cell(y, x_))(ix))(iy)
+        return jnp.transpose(grid, (2, 0, 1))  # (C,ph,pw)
+
+    out = jax.vmap(pool_one)(rois)
+    return (out.astype(data.dtype),)

@@ -1,0 +1,184 @@
+"""Elementwise unary/binary/scalar operator families.
+
+Parity with reference `src/operator/tensor/elemwise_*` and
+`src/operator/mshadow_op.h` (the scalar functor zoo). Each op lowers to a
+jax.numpy expression; XLA fuses chains of these into single kernels, which
+replaces the reference's hand-bulked engine segments
+(`src/executor/graph_executor.cc:1377`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+from .registry import register, alias
+
+
+def _unary(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(params, x, _fn=fn):
+        return (_fn(x),)
+    return _op
+
+
+def _promote_scalar(x, s):
+    # reference scalar ops keep the array dtype
+    return jnp.asarray(s, dtype=x.dtype if jnp.issubdtype(x.dtype, jnp.inexact)
+                       or float(s) == int(s) else jnp.float32).astype(x.dtype)
+
+
+def _binary_b(name, fn, aliases=()):
+    """broadcast_* binary op (reference tensor/elemwise_binary_broadcast_op)."""
+    @register(name, aliases=aliases)
+    def _op(params, lhs, rhs, _fn=fn):
+        return (_fn(lhs, rhs),)
+    return _op
+
+
+def _binary_scalar(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(params, x, _fn=fn):
+        return (_fn(x, _promote_scalar(x, params["scalar"])),)
+    return _op
+
+
+# ---------------------------------------------------------------------------
+# unary math (mshadow_op.h functors)
+# ---------------------------------------------------------------------------
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("rint", jnp.rint)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("negative", jnp.negative, aliases=("_np_negative",))
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("gamma", lambda x: jnp.exp(jsp_special.gammaln(x)))
+_unary("gammaln", jsp_special.gammaln)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("_copy", lambda x: x, aliases=("identity",))
+_unary("zeros_like", jnp.zeros_like)
+_unary("ones_like", jnp.ones_like)
+_unary("BlockGrad", jax.lax.stop_gradient, aliases=("stop_gradient",))
+_unary("make_loss", lambda x: x, aliases=("MakeLoss",))
+
+
+@register("Cast", aliases=("cast",))
+def _cast(params, x):
+    from ..base import dtype_np
+    return (x.astype(dtype_np(params["dtype"])),)
+
+
+@register("clip")
+def _clip(params, x):
+    return (jnp.clip(x, params["a_min"], params["a_max"]),)
+
+
+@register("smooth_l1")
+def _smooth_l1(params, x):
+    """Reference `src/operator/tensor/elemwise_unary_op.cc` smooth_l1."""
+    s = params.get("scalar", 1.0)
+    s2 = s * s
+    absx = jnp.abs(x)
+    return (jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2),)
+
+
+# ---------------------------------------------------------------------------
+# binary broadcast family (tensor/elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+_binary_b("broadcast_add", jnp.add, aliases=("broadcast_plus", "elemwise_add", "_add", "_plus"))
+_binary_b("broadcast_sub", jnp.subtract, aliases=("broadcast_minus", "elemwise_sub", "_sub", "_minus"))
+_binary_b("broadcast_mul", jnp.multiply, aliases=("elemwise_mul", "_mul"))
+_binary_b("broadcast_div", jnp.divide, aliases=("elemwise_div", "_div"))
+_binary_b("broadcast_mod", jnp.mod, aliases=("_mod",))
+_binary_b("broadcast_power", jnp.power, aliases=("_power", "pow"))
+_binary_b("broadcast_maximum", jnp.maximum, aliases=("_maximum", "maximum"))
+_binary_b("broadcast_minimum", jnp.minimum, aliases=("_minimum", "minimum"))
+_binary_b("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
+_binary_b("broadcast_equal", lambda a, b: (a == b).astype(a.dtype), aliases=("_equal",))
+_binary_b("broadcast_not_equal", lambda a, b: (a != b).astype(a.dtype), aliases=("_not_equal",))
+_binary_b("broadcast_greater", lambda a, b: (a > b).astype(a.dtype), aliases=("_greater",))
+_binary_b("broadcast_greater_equal", lambda a, b: (a >= b).astype(a.dtype), aliases=("_greater_equal",))
+_binary_b("broadcast_lesser", lambda a, b: (a < b).astype(a.dtype), aliases=("_lesser",))
+_binary_b("broadcast_lesser_equal", lambda a, b: (a <= b).astype(a.dtype), aliases=("_lesser_equal",))
+_binary_b("broadcast_logical_and", lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype))
+_binary_b("broadcast_logical_or", lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype))
+_binary_b("broadcast_logical_xor", lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype))
+_binary_b("arctan2", jnp.arctan2, aliases=("_arctan2",))
+_binary_b("ldexp", lambda a, b: a * jnp.power(2.0, b), aliases=("_ldexp",))
+
+
+# ---------------------------------------------------------------------------
+# scalar family
+# ---------------------------------------------------------------------------
+_binary_scalar("_plus_scalar", jnp.add)
+_binary_scalar("_minus_scalar", jnp.subtract)
+_binary_scalar("_rminus_scalar", lambda x, s: s - x)
+_binary_scalar("_mul_scalar", jnp.multiply)
+_binary_scalar("_div_scalar", jnp.divide)
+_binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+_binary_scalar("_mod_scalar", jnp.mod)
+_binary_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_binary_scalar("_power_scalar", jnp.power)
+_binary_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_binary_scalar("_maximum_scalar", jnp.maximum)
+_binary_scalar("_minimum_scalar", jnp.minimum)
+_binary_scalar("_hypot_scalar", jnp.hypot)
+_binary_scalar("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_binary_scalar("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_binary_scalar("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_binary_scalar("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_binary_scalar("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_binary_scalar("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+_binary_scalar("_logical_and_scalar", lambda x, s: ((x != 0) & (s != 0)).astype(x.dtype))
+_binary_scalar("_logical_or_scalar", lambda x, s: ((x != 0) | (s != 0)).astype(x.dtype))
+_binary_scalar("_scatter_plus_scalar", jnp.add)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def _add_n(params, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return (out,)
+
+
+@register("where")
+def _where(params, cond, x, y):
+    c = cond if cond.ndim == x.ndim else cond.reshape(
+        cond.shape + (1,) * (x.ndim - cond.ndim))
+    return (jnp.where(c != 0, x, y),)

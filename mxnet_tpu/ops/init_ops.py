@@ -1,0 +1,53 @@
+"""Source / init operators (reference `src/operator/tensor/init_op.h`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+def _shape(params):
+    s = params.get("shape", ())
+    return (s,) if isinstance(s, int) else tuple(s)
+
+
+@register("_zeros", aliases=("zeros",))
+def _zeros(params):
+    return (jnp.zeros(_shape(params), dtype_np(params.get("dtype") or "float32")),)
+
+
+@register("_ones", aliases=("ones",))
+def _ones(params):
+    return (jnp.ones(_shape(params), dtype_np(params.get("dtype") or "float32")),)
+
+
+@register("_full", aliases=("full",))
+def _full(params):
+    return (jnp.full(_shape(params), params["value"],
+                     dtype_np(params.get("dtype") or "float32")),)
+
+
+@register("_arange", aliases=("arange",))
+def _arange(params):
+    out = jnp.arange(params.get("start", 0), params.get("stop"),
+                     params.get("step", 1.0),
+                     dtype_np(params.get("dtype") or "float32"))
+    rep = params.get("repeat", 1)
+    if rep > 1:
+        out = jnp.repeat(out, rep)
+    return (out,)
+
+
+@register("_eye", aliases=("eye",))
+def _eye(params):
+    return (jnp.eye(int(params["N"]), int(params.get("M") or params["N"]),
+                    k=int(params.get("k", 0)),
+                    dtype=dtype_np(params.get("dtype") or "float32")),)
+
+
+@register("_linspace", aliases=("linspace",))
+def _linspace(params):
+    return (jnp.linspace(params["start"], params["stop"], int(params["num"]),
+                         endpoint=params.get("endpoint", True),
+                         dtype=dtype_np(params.get("dtype") or "float32")),)

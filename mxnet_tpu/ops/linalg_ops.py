@@ -1,0 +1,117 @@
+"""Linear-algebra operators.
+
+Parity with reference `src/operator/tensor/la_op.cc` (_linalg_* family:
+gemm/gemm2/potrf/potri/trsm/trmm/sumlogdiag/syrk/gelqf/syevd). Lower to
+jax.numpy.linalg / lax.linalg which XLA maps to MXU-friendly routines.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _gemm(params, A, B, C):
+    ta, tb = params.get("transpose_a", False), params.get("transpose_b", False)
+    alpha = params.get("alpha", 1.0)
+    beta = params.get("beta", 1.0)
+    a = jnp.swapaxes(A, -1, -2) if ta else A
+    b = jnp.swapaxes(B, -1, -2) if tb else B
+    return (alpha * jnp.matmul(a, b) + beta * C,)
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _gemm2(params, A, B):
+    ta, tb = params.get("transpose_a", False), params.get("transpose_b", False)
+    alpha = params.get("alpha", 1.0)
+    a = jnp.swapaxes(A, -1, -2) if ta else A
+    b = jnp.swapaxes(B, -1, -2) if tb else B
+    return (alpha * jnp.matmul(a, b),)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _potrf(params, A):
+    L = jnp.linalg.cholesky(A)
+    if not params.get("lower", True):
+        L = jnp.swapaxes(L, -1, -2)
+    return (L,)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _potri(params, A):
+    # inverse of symmetric PSD matrix from its cholesky factor A
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    lower = params.get("lower", True)
+    Linv = lax.linalg.triangular_solve(A, eye, lower=lower, left_side=True)
+    if lower:
+        return (jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv),)
+    return (jnp.matmul(Linv, jnp.swapaxes(Linv, -1, -2)),)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _trsm(params, A, B):
+    alpha = params.get("alpha", 1.0)
+    out = lax.linalg.triangular_solve(
+        A, alpha * B,
+        left_side=not params.get("rightside", False),
+        lower=params.get("lower", True),
+        transpose_a=params.get("transpose", False))
+    return (out,)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _trmm(params, A, B):
+    alpha = params.get("alpha", 1.0)
+    lower = params.get("lower", True)
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if params.get("transpose", False):
+        tri = jnp.swapaxes(tri, -1, -2)
+    if params.get("rightside", False):
+        return (alpha * jnp.matmul(B, tri),)
+    return (alpha * jnp.matmul(tri, B),)
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _sumlogdiag(params, A):
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return (jnp.sum(jnp.log(d), axis=-1),)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _syrk(params, A):
+    alpha = params.get("alpha", 1.0)
+    if params.get("transpose", False):
+        return (alpha * jnp.matmul(jnp.swapaxes(A, -1, -2), A),)
+    return (alpha * jnp.matmul(A, jnp.swapaxes(A, -1, -2)),)
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def _gelqf(params, A):
+    # LQ factorization: A = L Q  (rows m <= cols n)
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    L = jnp.swapaxes(r, -1, -2)
+    Q = jnp.swapaxes(q, -1, -2)
+    # sign convention: diagonal of L non-negative
+    d = jnp.sign(jnp.diagonal(L, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d)
+    L = L * d[..., None, :]
+    Q = Q * d[..., :, None]
+    return (L, Q)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def _syevd(params, A):
+    w, v = jnp.linalg.eigh(A)
+    return (jnp.swapaxes(v, -1, -2), w)
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def _inverse(params, A):
+    return (jnp.linalg.inv(A),)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def _det(params, A):
+    return (jnp.linalg.det(A),)

@@ -1,0 +1,626 @@
+"""Neural-network operators.
+
+Parity with reference `src/operator/nn/` (Convolution, Deconvolution,
+FullyConnected, BatchNorm, LayerNorm, Pooling, Activation, Dropout, LRN,
+Softmax) plus the output/loss heads (`src/operator/softmax_output-inl.h`,
+regression outputs) and the fused RNN op (`src/operator/rnn-inl.h:49`,
+`cudnn_rnn-inl.h`).
+
+TPU-first design notes:
+- Convs/matmuls lower to `lax.conv_general_dilated` / `dot_general` so XLA
+  tiles them onto the MXU; no im2col (reference `nn/im2col.h`) is needed.
+- BatchNorm/bias/activation chains are left to XLA fusion instead of the
+  reference's cuDNN fused kernels.
+- The fused RNN op is a `lax.scan` over time — the compiler pipelines the
+  per-step matmuls; this replaces cuDNN's fused multi-layer RNN.
+- Output heads (SoftmaxOutput etc.) define their own gradient irrespective of
+  the incoming cotangent, exactly like the reference ops; realised with
+  `jax.custom_vjp`.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference nn/fully_connected-inl.h:84-104)
+# ---------------------------------------------------------------------------
+@register("FullyConnected")
+def _fully_connected(params, data, weight, *bias):
+    flatten = params.get("flatten", True)
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = jnp.dot(x, weight.T)
+    if not params.get("no_bias", False) and bias:
+        out = out + bias[0]
+    return (out,)
+
+
+# ---------------------------------------------------------------------------
+# Convolution (reference nn/convolution-inl.h; NCHW/OIHW layouts)
+# ---------------------------------------------------------------------------
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+def _tup(v, n, default):
+    if v is None or v == ():
+        return (default,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_dn(nd):
+    spec = "DHW"[3 - nd:]
+    return ("NC" + spec, "OI" + spec, "NC" + spec)
+
+
+@register("Convolution")
+def _convolution(params, data, weight, *bias):
+    kernel = tuple(params["kernel"])
+    nd = len(kernel)
+    stride = _tup(params.get("stride"), nd, 1)
+    dilate = _tup(params.get("dilate"), nd, 1)
+    pad = _tup(params.get("pad"), nd, 0)
+    groups = params.get("num_group", 1)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(nd))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    out = out.astype(data.dtype)
+    if not params.get("no_bias", False) and bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * nd)
+    return (out,)
+
+
+@register("Deconvolution")
+def _deconvolution(params, data, weight, *bias):
+    """Transposed conv via lhs-dilated conv (gradient-of-conv identity)."""
+    kernel = tuple(params["kernel"])
+    nd = len(kernel)
+    stride = _tup(params.get("stride"), nd, 1)
+    dilate = _tup(params.get("dilate"), nd, 1)
+    pad = _tup(params.get("pad"), nd, 0)
+    adj = _tup(params.get("adj"), nd, 0)
+    groups = params.get("num_group", 1)
+    # weight layout is (in_channels, out_channels//g, *kernel)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        ci, co_g = weight.shape[0], weight.shape[1]
+        w = w.reshape((groups, ci // groups, co_g) + kernel)
+        w = jnp.swapaxes(w, 1, 2).reshape((co_g * groups, ci // groups) + kernel)
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dn(nd))
+    padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
+               for k, p, a, d in zip(kernel, pad, adj, dilate)]
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups)
+    out = out.astype(data.dtype)
+    if not params.get("no_bias", False) and bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * nd)
+    return (out,)
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference nn/pooling-inl.h)
+# ---------------------------------------------------------------------------
+@register("Pooling", aliases=("Pooling_v1",))
+def _pooling(params, data):
+    pool_type = params.get("pool_type", "max")
+    global_pool = params.get("global_pool", False)
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = _tup(params["kernel"], nd, 1)
+        stride = _tup(params.get("stride"), nd, 1)
+        pad = _tup(params.get("pad"), nd, 0)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if params.get("pooling_convention", "valid") == "full" and not global_pool:
+        # ceil-mode output: extend right/bottom padding as needed
+        extra = []
+        for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+            in_sz = data.shape[2 + i]
+            out_full = int(np.ceil((in_sz + 2 * p - k) / s)) + 1
+            needed = (out_full - 1) * s + k - in_sz - p
+            extra.append((p, max(needed, p)))
+        padding = ((0, 0), (0, 0)) + tuple(extra)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        out = lax.reduce_window(data, init, lax.max, window, strides, padding)
+    elif pool_type in ("avg", "sum"):
+        out = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "avg":
+            if params.get("count_include_pad", True):
+                out = out / float(np.prod(kernel))
+            else:
+                ones = jnp.ones_like(data)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+                out = out / cnt
+    else:
+        raise MXNetError("unsupported pool_type " + pool_type)
+    return (out.astype(data.dtype),)
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool(params, data):
+    oh, ow = _tup(params.get("output_size", 1), 2, 1)
+    n, c, h, w = data.shape
+    if h % oh == 0 and w % ow == 0:
+        out = data.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    else:
+        out = jax.image.resize(data, (n, c, oh, ow), method="linear")
+    return (out,)
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize(params, data):
+    n, c, _, _ = data.shape
+    h, w = params["height"], params["width"]
+    return (jax.image.resize(data, (n, c, h, w), method="linear").astype(data.dtype),)
+
+
+@register("UpSampling")
+def _upsampling(params, *inputs):
+    scale = params["scale"]
+    sample_type = params.get("sample_type", "nearest")
+    data = inputs[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(data, (n, c, h * scale, w * scale), method="linear")
+    return (out.astype(data.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+@register("BatchNorm", aliases=("BatchNorm_v1",), need_train_flag=True,
+          num_outputs=3, mutate_aux=(3, 4))
+def _batch_norm(params, data, gamma, beta, moving_mean, moving_var):
+    """Reference nn/batch_norm-inl.h. Outputs (out, mean, var); updates the
+    moving stats aux inputs in place during training."""
+    eps = params.get("eps", 1e-3)
+    momentum = params.get("momentum", 0.9)
+    axis = params.get("axis", 1)
+    fix_gamma = params.get("fix_gamma", True)
+    use_global = params.get("use_global_stats", False) or not params.get("_is_train", False)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    red_axes = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    bshape = tuple(-1 if i == axis % data.ndim else 1 for i in range(data.ndim))
+    if use_global:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    else:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red_axes)
+        var = jnp.var(x32, axis=red_axes)
+        new_mm = lax.stop_gradient(momentum * moving_mean + (1 - momentum) * mean.astype(moving_mean.dtype))
+        new_mv = lax.stop_gradient(momentum * moving_var + (1 - momentum) * var.astype(moving_var.dtype))
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
+    out = (data - mean.astype(data.dtype).reshape(bshape)) * inv.reshape(bshape) \
+        * g.reshape(bshape) + beta.reshape(bshape)
+    return (out, mean, var, new_mm, new_mv)
+
+
+@register("LayerNorm", num_outputs=3)
+def _layer_norm(params, data, gamma, beta):
+    """Reference nn/layer_norm.cc; statistics in fp32 for bf16 stability."""
+    axis = params.get("axis", -1)
+    eps = params.get("eps", 1e-5)
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    out = ((x32 - mean) * inv).astype(data.dtype)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = out * gamma.reshape(shape) + beta.reshape(shape)
+    return (out, jnp.squeeze(mean, axis), jnp.squeeze(jnp.sqrt(var + eps), axis))
+
+
+@register("InstanceNorm")
+def _instance_norm(params, data, gamma, beta):
+    eps = params.get("eps", 1e-3)
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    return (out * gamma.reshape(shape) + beta.reshape(shape),)
+
+
+@register("LRN")
+def _lrn(params, data):
+    """Reference lrn-inl.h: cross-channel local response normalisation."""
+    nsize = params["nsize"]
+    alpha = params.get("alpha", 1e-4)
+    beta = params.get("beta", 0.75)
+    knorm = params.get("knorm", 2.0)
+    sq = jnp.square(data)
+    half = nsize // 2
+    window = (1, nsize) + (1,) * (data.ndim - 2)
+    strides = (1,) * data.ndim
+    padding = ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2)
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, padding)
+    return (data / jnp.power(knorm + alpha / nsize * ssum, beta),)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+@register("Activation")
+def _activation(params, data):
+    act = params["act_type"]
+    if act == "relu":
+        return (jax.nn.relu(data),)
+    if act == "sigmoid":
+        return (jax.nn.sigmoid(data),)
+    if act == "tanh":
+        return (jnp.tanh(data),)
+    if act == "softrelu":
+        return (jax.nn.softplus(data),)
+    if act == "softsign":
+        return (jax.nn.soft_sign(data),)
+    raise MXNetError("unknown act_type " + act)
+
+
+@register("LeakyReLU", need_rng=True, need_train_flag=True)
+def _leaky_relu(params, data, *gamma):
+    act = params.get("act_type", "leaky")
+    slope = params.get("slope", 0.25)
+    if act == "leaky":
+        return (jnp.where(data >= 0, data, slope * data),)
+    if act == "elu":
+        return (jnp.where(data >= 0, data, slope * jnp.expm1(data)),)
+    if act == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return (s * jnp.where(data >= 0, data, a * jnp.expm1(data)),)
+    if act == "prelu":
+        g = gamma[0].reshape((1, -1) + (1,) * (data.ndim - 2))
+        return (jnp.where(data >= 0, data, g * data),)
+    if act == "rrelu":
+        lo, hi = params.get("lower_bound", 0.125), params.get("upper_bound", 0.334)
+        if params.get("_is_train", False):
+            key = params["_rng_key"]
+            slopes = jax.random.uniform(key, data.shape, data.dtype, lo, hi)
+        else:
+            slopes = (lo + hi) / 2.0
+        return (jnp.where(data >= 0, data, slopes * data),)
+    raise MXNetError("unknown act_type " + act)
+
+
+@register("softmax")
+def _softmax(params, data):
+    axis = params.get("axis", -1)
+    t = params.get("temperature") or 1.0
+    return (jax.nn.softmax(data / t, axis=axis),)
+
+
+@register("log_softmax")
+def _log_softmax(params, data):
+    axis = params.get("axis", -1)
+    t = params.get("temperature") or 1.0
+    return (jax.nn.log_softmax(data / t, axis=axis),)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(params, data):
+    mode = params.get("mode", "instance")
+    if mode == "channel":
+        return (jax.nn.softmax(data, axis=1),)
+    return (jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape),)
+
+
+@register("Dropout", need_rng=True, need_train_flag=True, num_outputs=2)
+def _dropout(params, data):
+    """Reference nn/dropout-inl.h; outputs (out, mask)."""
+    p = params.get("p", 0.5)
+    mode = params.get("mode", "training")
+    active = params.get("_is_train", False) or mode == "always"
+    if not active or p <= 0:
+        return (data, jnp.ones_like(data))
+    key = params["_rng_key"]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, data.shape).astype(data.dtype) / keep
+    return (data * mask, mask)
+
+
+# ---------------------------------------------------------------------------
+# Output heads: ops that define their own gradient (loss layers)
+# ---------------------------------------------------------------------------
+def _normalize_grad(grad, label, params, per_example_dim):
+    scale = params.get("grad_scale", 1.0)
+    norm = params.get("normalization", "null")
+    if norm == "batch":
+        scale = scale / label.shape[0]
+    elif norm == "valid":
+        ignore = params.get("ignore_label", -1)
+        valid = jnp.maximum(jnp.sum(label != ignore), 1).astype(grad.dtype)
+        scale = scale / valid
+    return grad * scale
+
+
+@jax.custom_vjp
+def _softmax_output_fwd(data, label, params_tuple):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _so_fwd(data, label, params_tuple):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label, params_tuple)
+
+
+def _so_bwd(res, g):
+    out, label, params_tuple = res
+    params = dict(params_tuple)
+    n_class = out.shape[-1]
+    oh = jax.nn.one_hot(label.astype(jnp.int32), n_class, dtype=out.dtype)
+    grad = out - oh
+    if params.get("use_ignore", False):
+        ignore = params.get("ignore_label", -1)
+        mask = (label != ignore).astype(out.dtype)
+        grad = grad * mask[..., None]
+    grad = _normalize_grad(grad, label, params, None)
+    return grad, None, None
+
+
+_softmax_output_fwd.defvjp(_so_fwd, _so_bwd)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def _softmax_output(params, data, label):
+    """Reference softmax_output-inl.h: forward softmax, backward (p - y)."""
+    multi = params.get("multi_output", False)
+    ptuple = tuple(sorted((k, v) for k, v in params.items()
+                          if isinstance(v, (int, float, bool, str))))
+    if multi:
+        # data (N, C, d...) label (N, d...): softmax over axis 1
+        perm = (0,) + tuple(range(2, data.ndim)) + (1,)
+        inv = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
+        out = _softmax_output_fwd(jnp.transpose(data, perm), label, ptuple)
+        return (jnp.transpose(out, inv),)
+    if data.ndim > 2:
+        out = _softmax_output_fwd(data.reshape(-1, data.shape[-1]),
+                                  label.reshape(-1), ptuple)
+        return (out.reshape(data.shape),)
+    return (_softmax_output_fwd(data, label, ptuple),)
+
+
+def _make_output_head(name, fwd_fn, grad_fn):
+    @jax.custom_vjp
+    def _f(data, label, ptuple):
+        return fwd_fn(data)
+
+    def _f_fwd(data, label, ptuple):
+        out = fwd_fn(data)
+        return out, (out, label, ptuple)
+
+    def _f_bwd(res, g):
+        out, label, ptuple = res
+        params = dict(ptuple)
+        grad = grad_fn(out, label)
+        grad = _normalize_grad(grad, label, params, None)
+        return grad, None, None
+
+    _f.defvjp(_f_fwd, _f_bwd)
+
+    @register(name)
+    def _op(params, data, label):
+        ptuple = tuple(sorted((k, v) for k, v in params.items()
+                              if isinstance(v, (int, float, bool, str))))
+        return (_f(data, label, ptuple),)
+    return _op
+
+
+_make_output_head("LinearRegressionOutput", lambda x: x,
+                  lambda o, l: (o - l) / 1.0)
+_make_output_head("LogisticRegressionOutput", jax.nn.sigmoid,
+                  lambda o, l: (o - l))
+_make_output_head("MAERegressionOutput", lambda x: x,
+                  lambda o, l: jnp.sign(o - l))
+_make_output_head("SVMOutput", lambda x: x,
+                  lambda o, l: _svm_grad(o, l))
+
+
+def _svm_grad(out, label, margin=1.0):
+    n_class = out.shape[-1]
+    oh = jax.nn.one_hot(label.astype(jnp.int32), n_class, dtype=out.dtype)
+    # L1-SVM gradient
+    viol = ((margin - out * (2 * oh - 1)) > 0).astype(out.dtype)
+    return -viol * (2 * oh - 1)
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(params, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=data.dtype)
+    return (-jnp.sum(oh * logp),)
+
+
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def _ctc_loss(params, data, label, *lens):
+    """Reference src/operator/contrib/ctc_loss-inl.h. data (T, B, C),
+    label (B, L) padded with 0/-1. Forward-backward in log space via scan."""
+    T, B, C = data.shape
+    blank_first = params.get("blank_label", "first") == "first"
+    blank = 0 if blank_first else C - 1
+    lab = label.astype(jnp.int32)
+    L = lab.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    # extended label seq: blank l1 blank l2 ... blank => length 2L+1
+    ext = jnp.full((B, 2 * L + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    pad_val = 0 if blank_first else -1
+    lab_valid = (lab != pad_val) if blank_first else (lab >= 0)
+    lab_len = jnp.sum(lab_valid.astype(jnp.int32), axis=1)
+    ext_len = 2 * lab_len + 1
+    if lens:
+        data_len = lens[0].astype(jnp.int32) if params.get("use_data_lengths") else jnp.full((B,), T, jnp.int32)
+    else:
+        data_len = jnp.full((B,), T, jnp.int32)
+    NEG = -1e10
+    S = 2 * L + 1
+    pos = jnp.arange(S)[None, :]
+    alpha0 = jnp.where(pos < 2, 0.0, NEG)  # can start at blank or first label
+    gather = jax.vmap(lambda lp, e: lp[e])  # (B,C),(B,S)->(B,S)
+
+    def step(alpha, lp_t):
+        em = gather(lp_t, ext)
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        allow_skip = (ext != blank) & (ext != ext_m2)
+        a2 = jnp.where(allow_skip, a2, NEG)
+        new = jnp.logaddexp(jnp.logaddexp(a0, a1), a2) + em
+        return new, new
+
+    _, alphas = lax.scan(step, alpha0, logp)
+    # pick alpha at t = data_len-1, positions ext_len-1 and ext_len-2
+    t_idx = jnp.clip(data_len - 1, 0, T - 1)
+    final = jnp.take_along_axis(alphas, t_idx[None, :, None], axis=0)[0]  # (B, S)
+    a_end = jnp.take_along_axis(final, (ext_len - 1)[:, None], axis=1)[:, 0]
+    a_end2 = jnp.take_along_axis(final, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(a_end, a_end2)
+    return (loss.astype(data.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (reference rnn-inl.h modes rnn_relu/rnn_tanh/lstm/gru)
+# ---------------------------------------------------------------------------
+def _rnn_nout(params):
+    if not params.get("state_outputs", False):
+        return 1
+    return 3 if params["mode"] == "lstm" else 2
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total flat parameter count, cuDNN layout (reference rnn-inl.h:106)."""
+    g = _gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for _ in range(d):
+            size += g * state_size * (in_sz + state_size)  # i2h + h2h weights
+            size += 2 * g * state_size                      # i2h + h2h biases
+    return size
+
+
+def _unpack_rnn_params(flat, num_layers, input_size, state_size, bidir, mode):
+    g = _gates(mode)
+    d = 2 if bidir else 1
+    offset = 0
+    weights = []
+    # cuDNN layout: all weights (layer-major, dir-minor), then all biases
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for dr in range(d):
+            w_i2h = lax.dynamic_slice(flat, (offset,), (g * state_size * in_sz,)).reshape(g * state_size, in_sz)
+            offset += g * state_size * in_sz
+            w_h2h = lax.dynamic_slice(flat, (offset,), (g * state_size * state_size,)).reshape(g * state_size, state_size)
+            offset += g * state_size * state_size
+            weights.append((w_i2h, w_h2h))
+    biases = []
+    for layer in range(num_layers):
+        for dr in range(d):
+            b_i2h = lax.dynamic_slice(flat, (offset,), (g * state_size,))
+            offset += g * state_size
+            b_h2h = lax.dynamic_slice(flat, (offset,), (g * state_size,))
+            offset += g * state_size
+            biases.append((b_i2h, b_h2h))
+    return weights, biases
+
+
+def _rnn_cell_scan(mode, x_seq, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse=False):
+    """One direction of one layer. x_seq (T,B,I) -> (T,B,H)."""
+    H = h0.shape[-1]
+
+    def cell(carry, x_t):
+        h, c = carry
+        gates = jnp.dot(x_t, w_i2h.T) + b_i2h + jnp.dot(h, w_h2h.T) + b_h2h
+        if mode == "lstm":
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        if mode == "gru":
+            # cuDNN gru: r, z, n with separate h2h for n
+            xr, xz, xn = jnp.split(jnp.dot(x_t, w_i2h.T) + b_i2h, 3, axis=-1)
+            hr, hz, hn = jnp.split(jnp.dot(h, w_h2h.T) + b_h2h, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new, c), h_new
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+        h_new = act(gates)
+        return (h_new, c), h_new
+
+    (h_f, c_f), ys = lax.scan(cell, (h0, c0), x_seq, reverse=reverse)
+    return ys, h_f, c_f
+
+
+@register("RNN", num_outputs=_rnn_nout, need_train_flag=True, need_rng=True)
+def _rnn(params, data, parameters, state, *state_cell):
+    """Fused multi-layer (bi)RNN via lax.scan (replaces cudnn_rnn-inl.h)."""
+    mode = params["mode"]
+    H = params["state_size"]
+    num_layers = params.get("num_layers", 1)
+    bidir = params.get("bidirectional", False)
+    p_drop = params.get("p", 0.0)
+    d = 2 if bidir else 1
+    T, B, I = data.shape
+    c_in = state_cell[0] if (mode == "lstm" and state_cell) else jnp.zeros_like(state)
+    weights, biases = _unpack_rnn_params(parameters, num_layers, I, H, bidir, mode)
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        outs = []
+        for dr in range(d):
+            li = layer * d + dr
+            h0 = state[li]
+            c0 = c_in[li]
+            w_i2h, w_h2h = weights[li]
+            b_i2h, b_h2h = biases[li]
+            ys, h_f, c_f = _rnn_cell_scan(mode, x, h0, c0, w_i2h, w_h2h,
+                                          b_i2h, b_h2h, reverse=(dr == 1))
+            outs.append(ys)
+            h_finals.append(h_f)
+            c_finals.append(c_f)
+        x = jnp.concatenate(outs, axis=-1) if d == 2 else outs[0]
+        if p_drop > 0 and params.get("_is_train", False) and layer < num_layers - 1:
+            key = jax.random.fold_in(params["_rng_key"], layer)
+            mask = jax.random.bernoulli(key, 1 - p_drop, x.shape).astype(x.dtype)
+            x = x * mask / (1 - p_drop)
+    h_out = jnp.stack(h_finals, axis=0)
+    outs = (x,)
+    if params.get("state_outputs", False):
+        outs = outs + (h_out,)
+        if mode == "lstm":
+            outs = outs + (jnp.stack(c_finals, axis=0),)
+    return outs

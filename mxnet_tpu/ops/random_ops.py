@@ -1,0 +1,154 @@
+"""Random sampling operators.
+
+Parity with reference `src/operator/random/sample_op.cc` (uniform, normal,
+gamma, exponential, poisson, negative_binomial, generalized_negative_binomial,
+randint, multinomial, shuffle) and `random/multisample_op.cc`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+def _shape_dtype(params):
+    shape = params.get("shape", (1,))
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = dtype_np(params.get("dtype") or "float32")
+    return tuple(shape), dt
+
+
+@register("_random_uniform", aliases=("uniform", "random_uniform"), need_rng=True)
+def _uniform(params, *args):
+    shape, dt = _shape_dtype(params)
+    lo = params.get("low", 0.0)
+    hi = params.get("high", 1.0)
+    return (jax.random.uniform(params["_rng_key"], shape, dt, lo, hi),)
+
+
+@register("_random_normal", aliases=("normal", "random_normal"), need_rng=True)
+def _normal(params, *args):
+    shape, dt = _shape_dtype(params)
+    mu = params.get("loc", 0.0)
+    sigma = params.get("scale", 1.0)
+    return (mu + sigma * jax.random.normal(params["_rng_key"], shape, dt),)
+
+
+@register("_random_gamma", aliases=("gamma_sample", "random_gamma"), need_rng=True)
+def _gamma(params, *args):
+    shape, dt = _shape_dtype(params)
+    alpha = params.get("alpha", 1.0)
+    beta = params.get("beta", 1.0)
+    return (beta * jax.random.gamma(params["_rng_key"], alpha, shape, dt),)
+
+
+@register("_random_exponential", aliases=("exponential", "random_exponential"), need_rng=True)
+def _exponential(params, *args):
+    shape, dt = _shape_dtype(params)
+    lam = params.get("lam", 1.0)
+    return (jax.random.exponential(params["_rng_key"], shape, dt) / lam,)
+
+
+@register("_random_poisson", aliases=("poisson", "random_poisson"), need_rng=True)
+def _poisson(params, *args):
+    shape, dt = _shape_dtype(params)
+    lam = params.get("lam", 1.0)
+    return (jax.random.poisson(params["_rng_key"], lam, shape).astype(dt),)
+
+
+@register("_random_negative_binomial", aliases=("negative_binomial",), need_rng=True)
+def _negbin(params, *args):
+    shape, dt = _shape_dtype(params)
+    k = params.get("k", 1)
+    p = params.get("p", 1.0)
+    key1, key2 = jax.random.split(params["_rng_key"])
+    lam = jax.random.gamma(key1, k, shape) * (1 - p) / p
+    return (jax.random.poisson(key2, lam, shape).astype(dt),)
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("generalized_negative_binomial",), need_rng=True)
+def _gen_negbin(params, *args):
+    shape, dt = _shape_dtype(params)
+    mu = params.get("mu", 1.0)
+    alpha = params.get("alpha", 1.0)
+    key1, key2 = jax.random.split(params["_rng_key"])
+    if alpha <= 0:
+        return (jax.random.poisson(key1, mu, shape).astype(dt),)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(key1, r, shape) * (1 - p) / p
+    return (jax.random.poisson(key2, lam, shape).astype(dt),)
+
+
+@register("_random_randint", aliases=("randint",), need_rng=True)
+def _randint(params, *args):
+    shape = params.get("shape", (1,))
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = dtype_np(params.get("dtype") or "int32")
+    return (jax.random.randint(params["_rng_key"], tuple(shape),
+                               params["low"], params["high"], dt),)
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial", "multinomial"),
+          need_rng=True, num_outputs=lambda p: 2 if p.get("get_prob") else 1)
+def _multinomial(params, data):
+    n = params.get("shape", 1)
+    if isinstance(n, (tuple, list)):
+        n = int(n[0]) if n else 1
+    dt = dtype_np(params.get("dtype", "int32"))
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(params["_rng_key"], logits, shape=(n,))
+    else:
+        out = jax.random.categorical(params["_rng_key"], logits[:, None, :],
+                                     axis=-1, shape=(data.shape[0], n))
+    if n == 1:
+        out = out.squeeze(-1) if out.ndim > 1 or data.ndim == 1 else out
+    out = out.astype(dt)
+    if params.get("get_prob"):
+        lp = jnp.take_along_axis(jax.nn.log_softmax(logits, -1),
+                                 out.reshape(out.shape + (1,)).astype(jnp.int32), -1)
+        return (out, lp.squeeze(-1))
+    return (out,)
+
+
+@register("_shuffle", aliases=("shuffle",), need_rng=True)
+def _shuffle(params, data):
+    perm = jax.random.permutation(params["_rng_key"], data.shape[0])
+    return (jnp.take(data, perm, axis=0),)
+
+
+# multisample (per-element distribution parameters as tensors)
+def _multisample(name, sampler):
+    @register(name, need_rng=True)
+    def _op(params, *dist_args):
+        shape = params.get("shape", ())
+        if isinstance(shape, int):
+            shape = (shape,)
+        out_shape = dist_args[0].shape + tuple(shape)
+        return (sampler(params["_rng_key"], dist_args, out_shape,
+                        dtype_np(params.get("dtype") or "float32")),)
+    return _op
+
+
+_multisample("_sample_uniform", lambda k, a, s, dt:
+             a[0].reshape(a[0].shape + (1,) * (len(s) - a[0].ndim)) +
+             (a[1] - a[0]).reshape(a[0].shape + (1,) * (len(s) - a[0].ndim)) *
+             jax.random.uniform(k, s, dt))
+_multisample("_sample_normal", lambda k, a, s, dt:
+             a[0].reshape(a[0].shape + (1,) * (len(s) - a[0].ndim)) +
+             a[1].reshape(a[1].shape + (1,) * (len(s) - a[1].ndim)) *
+             jax.random.normal(k, s, dt))
+_multisample("_sample_gamma", lambda k, a, s, dt:
+             a[1].reshape(a[1].shape + (1,) * (len(s) - a[1].ndim)) *
+             jax.random.gamma(k, a[0].reshape(a[0].shape + (1,) * (len(s) - a[0].ndim)), s, dt))
+_multisample("_sample_exponential", lambda k, a, s, dt:
+             jax.random.exponential(k, s, dt) /
+             a[0].reshape(a[0].shape + (1,) * (len(s) - a[0].ndim)))
+_multisample("_sample_poisson", lambda k, a, s, dt:
+             jax.random.poisson(k, a[0].reshape(a[0].shape + (1,) * (len(s) - a[0].ndim)), s).astype(dt))

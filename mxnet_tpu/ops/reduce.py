@@ -1,0 +1,72 @@
+"""Reduction operators.
+
+Parity with reference `src/operator/tensor/broadcast_reduce_op.h`
+(sum/mean/prod/max/min/norm/nansum/nanprod with axis/keepdims/exclude).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axis(x, params):
+    axis = params.get("axis")
+    if axis is None or axis == ():
+        axis = None
+    elif isinstance(axis, int):
+        axis = (axis,)
+    else:
+        axis = tuple(axis)
+    if params.get("exclude") and axis is not None:
+        axis = tuple(i for i in range(x.ndim) if i not in
+                     tuple(a % x.ndim for a in axis))
+    return axis
+
+
+def _reduce(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(params, x, _fn=fn):
+        axis = _norm_axis(x, params)
+        return (_fn(x, axis=axis, keepdims=params.get("keepdims", False)),)
+    return _op
+
+
+_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=("max_axis",))
+_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def _norm(params, x):
+    ord_ = params.get("ord", 2)
+    axis = _norm_axis(x, params)
+    keepdims = params.get("keepdims", False)
+    if ord_ == 1:
+        return (jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims),)
+    return (jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims)),)
+
+
+@register("L2Normalization")
+def _l2_normalization(params, x):
+    """Reference src/operator/l2_normalization-inl.h (instance/channel/spatial)."""
+    eps = params.get("eps", 1e-10)
+    mode = params.get("mode", "instance")
+    if mode == "instance":
+        axis = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axis = (1,)
+    else:  # spatial
+        axis = tuple(range(2, x.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return (x / nrm,)
+
+
+@register("square_sum")
+def _square_sum(params, x):
+    axis = _norm_axis(x, params)
+    return (jnp.sum(jnp.square(x), axis=axis, keepdims=params.get("keepdims", False)),)

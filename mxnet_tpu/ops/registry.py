@@ -1,0 +1,88 @@
+"""Operator registry.
+
+TPU-native analog of the reference's NNVM op registry
+(`include/mxnet/op_attr_types.h`, `src/operator/*` NNVM_REGISTER_OP): each op
+declares a pure compute function over jax.numpy values. Because the compute
+functions are traceable JAX, a single registration gives us all four of the
+reference's execution paths at once:
+
+- eager dispatch        (reference FCompute via Imperative::Invoke)
+- autograd              (reference Gradient pass; here `jax.vjp` of fcompute)
+- whole-graph compile   (reference GraphExecutor/CachedOp; here `jax.jit`)
+- device placement      (reference PlaceDevice; here jax shardings/devices)
+
+An op's ``fcompute(params, *inputs)`` takes a dict of scalar attributes
+(reference dmlc::Parameter struct) and jnp arrays, returning a tuple of jnp
+arrays. ``is_train`` and the RNG key are passed through ``params`` when the op
+declares it needs them (reference ResourceRequest/`OpContext.is_train`).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["Operator", "register", "get_op", "list_ops", "alias"]
+
+_OPS = {}
+
+
+class Operator:
+    def __init__(self, name, fcompute, num_outputs=1, need_train_flag=False,
+                 need_rng=False, visible=True, mutate_aux=None, doc=""):
+        self.name = name
+        self.fcompute = fcompute
+        # int, or callable(params)->int for variable-output ops (e.g. split)
+        self.num_outputs = num_outputs
+        self.need_train_flag = need_train_flag
+        self.need_rng = need_rng
+        self.visible = visible
+        # indices of inputs that the op updates in place (BatchNorm moving
+        # stats; reference mutable aux states). fcompute returns the new
+        # values appended after the regular outputs.
+        self.mutate_aux = mutate_aux or ()
+        self.doc = doc
+
+    def n_out(self, params):
+        if callable(self.num_outputs):
+            return self.num_outputs(params)
+        return self.num_outputs
+
+    def __repr__(self):
+        return "Operator(%s)" % self.name
+
+
+def register(name, num_outputs=1, aliases=(), need_train_flag=False,
+             need_rng=False, visible=True, mutate_aux=None):
+    """Decorator registering ``fcompute`` under ``name`` (+aliases)."""
+
+    def deco(fcompute):
+        op = Operator(name, fcompute, num_outputs=num_outputs,
+                      need_train_flag=need_train_flag, need_rng=need_rng,
+                      visible=visible, mutate_aux=mutate_aux,
+                      doc=fcompute.__doc__ or "")
+        _OPS[name] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fcompute
+
+    return deco
+
+
+def alias(existing, *names):
+    op = get_op(existing)
+    for n in names:
+        _OPS[n] = op
+
+
+def get_op(name) -> Operator:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise MXNetError("Operator %s is not registered" % name) from None
+
+
+def has_op(name):
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS)

@@ -1,0 +1,479 @@
+"""Shape / layout / indexing operators.
+
+Parity with reference `src/operator/tensor/matrix_op-inl.h` (reshape,
+transpose, slice, concat, stack, tile, repeat, pad, flip, depth/space,
+diag, batch_dot, dot) and `src/operator/tensor/indexing_op.h` (take,
+Embedding, one_hot, gather_nd, scatter_nd, pick, batch_take) and
+`src/operator/tensor/ordering_op-inl.h` (sort/argsort/topk).
+
+Static shapes are required under jit — reshape specs (0/-1/-2/-3/-4 codes,
+reference matrix_op-inl.h ReshapeParam) are resolved at trace time from the
+concrete input shape, matching XLA's compilation model.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, dtype_np
+from .registry import register
+
+
+def infer_reshape(src_shape, spec, reverse=False):
+    """Implement the reference reshape shape-spec language
+    (matrix_op-inl.h:95-180): 0 copy-dim, -1 infer, -2 copy-rest,
+    -3 merge-two, -4 split-two."""
+    if reverse:
+        src = list(src_shape)[::-1]
+        spec_l = list(spec)[::-1]
+        out = infer_reshape(src, spec_l, reverse=False)
+        return tuple(out[::-1])
+    src = list(src_shape)
+    out = []
+    si = 0
+    i = 0
+    spec = list(spec)
+    while i < len(spec):
+        s = spec[i]
+        if s == 0:
+            out.append(src[si]); si += 1
+        elif s == -1:
+            out.append(-1); si += 1
+        elif s == -2:
+            out.extend(src[si:]); si = len(src)
+        elif s == -3:
+            out.append(src[si] * src[si + 1]); si += 2
+        elif s == -4:
+            d1, d2 = spec[i + 1], spec[i + 2]
+            if d1 == -1:
+                d1 = src[si] // d2
+            if d2 == -1:
+                d2 = src[si] // d1
+            out.extend([d1, d2]); si += 1; i += 2
+        else:
+            out.append(int(s)); si += 1
+        i += 1
+    if -1 in out:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in src_shape:
+            total *= v
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(params, x):
+    shape = params.get("shape", ())
+    reverse = params.get("reverse", False)
+    tgt = infer_reshape(x.shape, shape, reverse)
+    return (jnp.reshape(x, tgt),)
+
+
+@register("reshape_like")
+def _reshape_like(params, x, other):
+    return (jnp.reshape(x, other.shape),)
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(params, x):
+    return (jnp.reshape(x, (x.shape[0], -1)),)
+
+
+@register("transpose")
+def _transpose(params, x):
+    axes = params.get("axes") or None
+    return (jnp.transpose(x, axes),)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def _swapaxes(params, x):
+    return (jnp.swapaxes(x, params["dim1"], params["dim2"]),)
+
+
+@register("expand_dims")
+def _expand_dims(params, x):
+    return (jnp.expand_dims(x, params["axis"]),)
+
+
+@register("squeeze")
+def _squeeze(params, x):
+    return (jnp.squeeze(x, params.get("axis")),)
+
+
+@register("broadcast_to")
+def _broadcast_to(params, x):
+    tgt = [t if t != 0 else s for t, s in zip(params["shape"], x.shape)]
+    return (jnp.broadcast_to(x, tuple(tgt)),)
+
+
+@register("broadcast_like")
+def _broadcast_like(params, x, other):
+    return (jnp.broadcast_to(x, other.shape),)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(params, x):
+    axes = params["axis"]
+    sizes = params["size"]
+    if not isinstance(axes, (tuple, list)):
+        axes, sizes = (axes,), (sizes,)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return (jnp.broadcast_to(x, tuple(tgt)),)
+
+
+@register("tile")
+def _tile(params, x):
+    return (jnp.tile(x, params["reps"]),)
+
+
+@register("repeat")
+def _repeat(params, x):
+    return (jnp.repeat(x, params["repeats"], axis=params.get("axis")),)
+
+
+@register("Pad", aliases=("pad",))
+def _pad(params, x):
+    pw = params["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = params.get("mode", "constant")
+    if mode == "constant":
+        return (jnp.pad(x, pairs, constant_values=params.get("constant_value", 0)),)
+    return (jnp.pad(x, pairs, mode=mode),)
+
+
+@register("slice", aliases=("crop",))
+def _slice(params, x):
+    begin, end = params["begin"], params["end"]
+    step = params.get("step") or [None] * len(begin)
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return (x[idx],)
+
+
+@register("slice_axis")
+def _slice_axis(params, x):
+    ax, b, e = params["axis"], params["begin"], params["end"]
+    if e is None or e == 0:
+        e = x.shape[ax]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(b, e)
+    return (x[tuple(idx)],)
+
+
+@register("slice_like")
+def _slice_like(params, x, like):
+    axes = params.get("axes") or tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return (x[tuple(idx)],)
+
+
+def _num_split(params):
+    return params["num_outputs"]
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_num_split)
+def _split(params, x):
+    n = params["num_outputs"]
+    axis = params.get("axis", 1)
+    outs = jnp.split(x, n, axis=axis)
+    if params.get("squeeze_axis"):
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register("Concat", aliases=("concat",))
+def _concat(params, *xs):
+    return (jnp.concatenate(xs, axis=params.get("dim", 1)),)
+
+
+@register("stack")
+def _stack(params, *xs):
+    return (jnp.stack(xs, axis=params.get("axis", 0)),)
+
+
+@register("flip", aliases=("reverse",))
+def _flip(params, x):
+    ax = params["axis"]
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return (jnp.flip(x, ax),)
+
+
+@register("depth_to_space")
+def _depth_to_space(params, x):
+    b = params["block_size"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return (y.reshape(n, c // (b * b), h * b, w * b),)
+
+
+@register("space_to_depth")
+def _space_to_depth(params, x):
+    b = params["block_size"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return (y.reshape(n, c * b * b, h // b, w // b),)
+
+
+@register("diag")
+def _diag(params, x):
+    k = params.get("k", 0)
+    if x.ndim == 1:
+        return (jnp.diag(x, k),)
+    return (jnp.diagonal(x, offset=k, axis1=params.get("axis1", 0),
+                         axis2=params.get("axis2", 1)),)
+
+
+@register("shape_array")
+def _shape_array(params, x):
+    return (jnp.asarray(np.array(x.shape, dtype=np.int64)),)
+
+
+@register("size_array")
+def _size_array(params, x):
+    return (jnp.asarray(np.array([int(np.prod(x.shape))], dtype=np.int64)),)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra entry points (tensor/dot-inl.h); the heavy path is the MXU.
+# ---------------------------------------------------------------------------
+@register("dot")
+def _dot(params, lhs, rhs):
+    ta, tb = params.get("transpose_a", False), params.get("transpose_b", False)
+    a = lhs.T if ta and lhs.ndim == 2 else (jnp.transpose(lhs) if ta else lhs)
+    b = rhs.T if tb and rhs.ndim == 2 else (jnp.transpose(rhs) if tb else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return (jnp.dot(a, b),)
+    # mxnet dot: contract last axis of a with first axis of b
+    return (jnp.tensordot(a, b, axes=([a.ndim - 1], [0])),)
+
+
+@register("batch_dot")
+def _batch_dot(params, lhs, rhs):
+    ta, tb = params.get("transpose_a", False), params.get("transpose_b", False)
+    a = jnp.swapaxes(lhs, -1, -2) if ta else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if tb else rhs
+    return (jnp.matmul(a, b),)
+
+
+@register("khatri_rao")
+def _khatri_rao(params, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, x).reshape(
+            out.shape[0] * x.shape[0], *out.shape[1:])
+    return (out,)
+
+
+# ---------------------------------------------------------------------------
+# indexing (tensor/indexing_op.h)
+# ---------------------------------------------------------------------------
+@register("take")
+def _take(params, a, indices):
+    axis = params.get("axis", 0)
+    mode = params.get("mode", "clip")
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:
+        idx = jnp.clip(idx, 0, n - 1)
+    return (jnp.take(a, idx, axis=axis),)
+
+
+@register("batch_take")
+def _batch_take(params, a, indices):
+    return (jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1),)
+
+
+@register("pick")
+def _pick(params, x, index):
+    axis = params.get("axis", -1)
+    keepdims = params.get("keepdims", False)
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis if axis >= 0 else x.ndim + axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return (out,)
+
+
+@register("Embedding")
+def _embedding(params, data, weight):
+    """Reference indexing_op.h Embedding: row gather feeding the MXU."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return (jnp.take(weight, idx, axis=0),)
+
+
+@register("one_hot")
+def _one_hot(params, indices):
+    depth = params["depth"]
+    on = params.get("on_value", 1.0)
+    off = params.get("off_value", 0.0)
+    dt = dtype_np(params.get("dtype", "float32"))
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dt)
+    return ((oh * (on - off) + off).astype(dt),)
+
+
+@register("gather_nd")
+def _gather_nd(params, data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return (data[idx],)
+
+
+@register("scatter_nd")
+def _scatter_nd(params, data, indices):
+    shape = params["shape"]
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, data.dtype)
+    return (out.at[idx].set(data),)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(params, lhs, rhs, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return (lhs.at[idx].set(rhs),)
+
+
+# ---------------------------------------------------------------------------
+# ordering (tensor/ordering_op-inl.h)
+# ---------------------------------------------------------------------------
+@register("sort")
+def _sort(params, x):
+    axis = params.get("axis", -1)
+    out = jnp.sort(x, axis=axis)
+    if not params.get("is_ascend", True):
+        out = jnp.flip(out, axis=axis)
+    return (out,)
+
+
+@register("argsort")
+def _argsort(params, x):
+    axis = params.get("axis", -1)
+    out = jnp.argsort(x, axis=axis)
+    if not params.get("is_ascend", True):
+        out = jnp.flip(out, axis=axis)
+    return (out.astype(dtype_np(params.get("dtype", "float32"))),)
+
+
+def _topk_nout(params):
+    rt = params.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout)
+def _topk(params, x):
+    axis = params.get("axis", -1)
+    k = params.get("k", 1)
+    rt = params.get("ret_typ", "indices")
+    is_ascend = params.get("is_ascend", False)
+    ax = axis if axis >= 0 else x.ndim + axis
+    xm = jnp.moveaxis(x, ax, -1)
+    if is_ascend:
+        vals, idxs = lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idxs = lax.top_k(xm, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    dt = dtype_np(params.get("dtype", "float32"))
+    if rt == "value":
+        return (vals,)
+    if rt == "both":
+        return (vals, idxs.astype(dt))
+    if rt == "mask":
+        mask = jnp.zeros(xm.shape, x.dtype)
+        mask = mask.at[..., :].set(0)
+        oh = jax.nn.one_hot(idxs, xm.shape[-1], dtype=x.dtype).sum(-2)
+        return (jnp.moveaxis(oh, -1, ax),)
+    return (idxs.astype(dt),)
+
+
+@register("argmax")
+def _argmax(params, x):
+    axis = params.get("axis")
+    keepdims = params.get("keepdims", False)
+    out = jnp.argmax(x.reshape(-1) if axis is None else x,
+                     axis=None if axis is None else axis)
+    out = out.astype(jnp.float32)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return (out,)
+
+
+@register("argmin")
+def _argmin(params, x):
+    axis = params.get("axis")
+    keepdims = params.get("keepdims", False)
+    out = jnp.argmin(x.reshape(-1) if axis is None else x,
+                     axis=None if axis is None else axis)
+    out = out.astype(jnp.float32)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return (out,)
+
+
+@register("argmax_channel")
+def _argmax_channel(params, x):
+    return (jnp.argmax(x, axis=1).astype(jnp.float32),)
+
+
+# sequence ops (src/operator/sequence_*.cc) ---------------------------------
+@register("SequenceMask")
+def _sequence_mask(params, data, *seqlen):
+    """data: (seq, batch, ...) masked beyond per-batch lengths."""
+    if not params.get("use_sequence_length", bool(seqlen)):
+        return (data,)
+    sl = seqlen[0]
+    value = params.get("value", 0.0)
+    axis = params.get("axis", 0)
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    if axis == 0:
+        mask = steps[:, None] < sl[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sl[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return (jnp.where(mask, data, value).astype(data.dtype),)
+
+
+@register("SequenceLast")
+def _sequence_last(params, data, *seqlen):
+    axis = params.get("axis", 0)
+    if not params.get("use_sequence_length", bool(seqlen)):
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return (data[tuple(idx)],)
+    sl = seqlen[0].astype(jnp.int32) - 1
+    dm = jnp.moveaxis(data, axis, 0)
+    out = jnp.take_along_axis(
+        dm, sl.reshape((1, -1) + (1,) * (dm.ndim - 2)), axis=0)[0]
+    return (out,)
+
+
+@register("SequenceReverse")
+def _sequence_reverse(params, data, *seqlen):
+    axis = params.get("axis", 0)
+    if not params.get("use_sequence_length", bool(seqlen)):
+        return (jnp.flip(data, axis),)
+    sl = seqlen[0].astype(jnp.int32)
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    dm = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    rev_idx = jnp.where(steps[:, None] < sl[None, :],
+                        sl[None, :] - 1 - steps[:, None], steps[:, None])
+    out = jnp.take_along_axis(
+        dm, rev_idx.reshape(rev_idx.shape + (1,) * (dm.ndim - 2)), axis=0)
+    return (jnp.moveaxis(out, 0, axis),)

@@ -1,0 +1,524 @@
+"""Optimizers (reference `python/mxnet/optimizer.py`, 1,519 LoC).
+
+Registry + Updater, with per-parameter lr/wd multipliers, lr scheduling,
+gradient rescale/clip and multi-precision (fp32 master weights for
+bf16/fp16 params — reference SGD multi_precision). The per-parameter update
+itself runs as a registered on-device op (`ops/optimizer_ops.py`), mirroring
+how the reference registers updates as operators so they execute inside the
+engine (`src/operator/optimizer_op.cc`).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from .ops.invoke import invoke
+
+__all__ = ["Optimizer", "SGD", "NAG", "Signum", "Adam", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "FTML", "DCASGD", "LBSGD", "SGLD", "Test",
+           "Updater", "get_updater", "create", "register"]
+
+
+class Optimizer:
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise ValueError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master weight for low-precision params (reference mp_sgd)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (np.float16, np.dtype("bfloat16")):
+            weight_master_copy = weight.astype("float32")
+            return (weight_master_copy,) + (self.create_state(index, weight_master_copy),)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and isinstance(state[0], NDArray) \
+                and state[0].dtype == np.float32 and weight.dtype != np.float32:
+            weight32, inner = state[0], state[1]
+            g32 = grad.astype("float32")
+            self.update(index, weight32, g32, inner)
+            weight[:] = weight32.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD (+momentum, multi-precision) — reference optimizer.py SGD."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, weight.context, dtype="float32")
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            kw["momentum"] = self.momentum
+            invoke("sgd_mom_update", [weight, grad, state], kw, out=weight)
+        else:
+            invoke("sgd_update", [weight, grad], kw, out=weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and len(state) == 2 \
+                and isinstance(state[0], NDArray) and state[0].dtype == np.float32 \
+                and weight.dtype != np.float32:
+            weight32, mom = state
+            self._update_count(index)
+            kw = self._common_kwargs(index)
+            if mom is not None:
+                kw["momentum"] = self.momentum
+                invoke("mp_sgd_mom_update", [weight, grad, mom, weight32], kw, out=weight)
+            else:
+                invoke("mp_sgd_update", [weight, grad, weight32], kw, out=weight)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        if state is not None:
+            state[:] = self.momentum * state + g
+            weight[:] = weight - lr * (self.momentum * state + g)
+        else:
+            weight[:] = weight - lr * g
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, weight.context, dtype="float32")
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw["wd_lh"] = self.wd_lh
+        if state is not None:
+            kw["momentum"] = self.momentum
+            invoke("signum_update", [weight, grad, state], kw, out=weight)
+        else:
+            invoke("signsgd_update", [weight, grad], kw, out=weight)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype="float32"),
+                zeros(weight.shape, weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        kw["lr"] = kw["lr"] * math.sqrt(coef2) / coef1
+        kw.update({"beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon})
+        mean, var = state
+        invoke("adam_update", [weight, grad, mean, var], kw, out=weight)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad.astype("float32") * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight.astype("float32")
+        state[:] = state + g * g
+        weight[:] = (weight.astype("float32") -
+                     lr * g / (state.sqrt() + self.float_stable_eps)).astype(weight.dtype)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1. - self.rho) * g * g
+        current_delta = ((acc_delta + self.epsilon).sqrt() /
+                         (acc_g + self.epsilon).sqrt()) * g
+        acc_delta[:] = self.rho * acc_delta + (1. - self.rho) * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context, dtype="float32"),
+                    zeros(weight.shape, weight.context, dtype="float32"),
+                    zeros(weight.shape, weight.context, dtype="float32"))
+        return zeros(weight.shape, weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update({"gamma1": self.gamma1, "epsilon": self.epsilon})
+        if self.centered:
+            n, g, delta = state
+            kw["gamma2"] = self.gamma2
+            invoke("rmspropalex_update", [weight, grad, n, g, delta], kw, out=weight)
+        else:
+            invoke("rmsprop_update", [weight, grad, state], kw, out=weight)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype="float32"),
+                zeros(weight.shape, weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update({"lamda1": self.lamda1, "beta": self.beta})
+        z, n = state
+        invoke("ftrl_update", [weight, grad, z, n], kw, out=weight)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype="float32"),
+                zeros(weight.shape, weight.context, dtype="float32"),
+                zeros(weight.shape, weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update({"beta1": self.beta1, "beta2": self.beta2,
+                   "epsilon": self.epsilon, "t": self._index_update_count[index]})
+        d, v, z = state
+        invoke("ftml_update", [weight, grad, d, v, z], kw, out=weight)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (g + wd * weight + self.lamda * g * g * (weight - previous_weight))
+        if mom is not None:
+            mom[:] = self.momentum * mom + delta
+            delta = mom
+        previous_weight[:] = weight
+        weight[:] = weight + delta
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def update(self, index, weight, grad, state):
+        from .ndarray import random as nd_random
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        weight[:] = weight - lr / 2 * (g + wd * weight) + \
+            nd_random.normal(0, math.sqrt(lr), shape=weight.shape,
+                             ctx=weight.context, dtype="float32").astype(weight.dtype)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptation
+    (reference optimizer.py LBSGD)."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.adaptive = True
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if self.adaptive:
+            wnorm = float(weight.norm().asscalar())
+            gnorm = float(grad.norm().asscalar()) * self.rescale_grad
+            if wnorm > 0 and gnorm > 0:
+                lr = lr * 0.001 * wnorm / (gnorm + wd * wnorm)
+        kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        if state is not None:
+            kw["momentum"] = self.momentum
+            invoke("sgd_mom_update", [weight, grad, state], kw, out=weight)
+        else:
+            invoke("sgd_update", [weight, grad], kw, out=weight)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight + grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """Applies an optimizer to indexed weights (reference optimizer.py Updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            states, self.optimizer = states
+
+        def _nd(s):
+            if s is None:
+                return None
+            if isinstance(s, np.ndarray):
+                from .ndarray import array as nd_array
+                return nd_array(s, dtype=s.dtype)
+            if isinstance(s, (tuple, list)):
+                return tuple(_nd(x) for x in s)
+            return s
+        self.states = {k: _nd(v) for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), True)
+
+    def get_states(self, dump_optimizer=False):
+        def _np(s):
+            if s is None:
+                return None
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return tuple(_np(x) for x in s)
+            return s
+        states = {k: _np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer else states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return Optimizer.create_optimizer(name, **kwargs)

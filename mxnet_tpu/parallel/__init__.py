@@ -1,0 +1,13 @@
+"""Parallelism toolkit: device meshes, sharded training steps, collectives.
+
+This is the TPU-native replacement for the reference's entire multi-device /
+multi-node story (SURVEY.md §2.8): DataParallelExecutorGroup, KVStore comm
+trees, NCCL, and the ps-lite parameter server all collapse into sharding
+annotations over a `jax.sharding.Mesh` with XLA-inserted collectives.
+"""
+from .mesh import MeshContext, get_mesh, data_parallel_mesh, make_mesh
+from . import dist
+from .data_parallel import DataParallelTrainStep, split_and_load_sharded
+
+__all__ = ["MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
+           "dist", "DataParallelTrainStep", "split_and_load_sharded"]

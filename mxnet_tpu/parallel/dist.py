@@ -1,0 +1,58 @@
+"""Multi-process collectives (replaces ps-lite, reference
+`src/kvstore/kvstore_dist.h`).
+
+Workers are `jax.distributed` processes; gradient sync is an allreduce over
+all processes' devices instead of push/pull against parameter servers. Roles
+(scheduler/server) disappear — every process is a worker, rank =
+`jax.process_index()` (reference `KVStore::get_rank`, kvstore.h:326).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init", "allreduce_nd", "barrier", "rank", "size"]
+
+_initialized = False
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialise multi-process JAX (reference `InitPSEnv`, kvstore.h:254;
+    env vars DMLC_* are honored for launcher compatibility)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("MX_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("DMLC_NUM_WORKER", "0")) or None
+    if process_id is None and "DMLC_WORKER_ID" in os.environ:
+        process_id = int(os.environ["DMLC_WORKER_ID"])
+    if coordinator_address:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _initialized = True
+
+
+def rank():
+    return jax.process_index()
+
+
+def size():
+    return jax.process_count()
+
+
+def allreduce_nd(nd):
+    """Sum an NDArray across processes (BSP dist_sync semantics)."""
+    if jax.process_count() == 1:
+        return nd
+    from jax.experimental import multihost_utils
+    from ..ndarray.ndarray import NDArray
+    summed = multihost_utils.process_allgather(nd._data).sum(axis=0)
+    return NDArray(summed, nd.ctx)
+
+
+def barrier():
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")

@@ -1,0 +1,66 @@
+"""Device meshes.
+
+The reference enumerates devices as flat ctx lists
+(`python/mxnet/module/executor_group.py:129`); TPU-native code arranges chips
+in a named `jax.sharding.Mesh` whose axes map onto parallelism strategies:
+
+    axes: ('dp', 'fsdp', 'tp', 'sp', 'pp', 'ep')  -- any subset
+
+Collectives over mesh axes ride ICI within a slice and DCN across slices
+(axis order controls which — earlier axes are outermost/DCN-most).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+__all__ = ["MeshContext", "get_mesh", "make_mesh", "data_parallel_mesh",
+           "PartitionSpec", "NamedSharding"]
+
+_STATE = threading.local()
+
+
+def make_mesh(axis_shapes, devices=None):
+    """Create a Mesh from {'axis': size} (sizes multiply to #devices;
+    one axis may be -1 to absorb the remainder)."""
+    devices = devices if devices is not None else jax.devices()
+    names = tuple(axis_shapes.keys())
+    sizes = list(axis_shapes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError("mesh axes %s need %d devices, have %d"
+                         % (axis_shapes, total, n))
+    dev_arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_arr, names)
+
+
+def data_parallel_mesh(devices=None):
+    return make_mesh({"dp": -1}, devices)
+
+
+class MeshContext:
+    """`with MeshContext(mesh):` makes `mesh` the ambient mesh for sharded
+    executors/trainers (analog of the reference's ctx-list argument)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._old = None
+
+    def __enter__(self):
+        self._old = getattr(_STATE, "mesh", None)
+        _STATE.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *a):
+        _STATE.mesh = self._old
+
+
+def get_mesh():
+    return getattr(_STATE, "mesh", None)

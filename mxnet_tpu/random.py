@@ -1,0 +1,63 @@
+"""Random number state.
+
+Parity with reference `src/common/random_generator.h` + `python/mxnet/random.py`.
+TPU-native: a counter-based threefry key (JAX PRNG) replaces the per-device
+mshadow RNG; `seed()` resets the root key. Sampling ops split a fresh subkey
+per call, so eager sampling is stateful at the API while each op stays pure
+(SURVEY.md §7 hard-part 7: bitwise parity with the reference RNG is
+deliberately not attempted; tests are statistical).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key"]
+
+
+class _RandState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.key = jax.random.PRNGKey(0)
+        self.override = None
+
+
+_STATE = _RandState()
+
+
+def seed(seed_state, ctx="all"):
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key(ctx=None):
+    """Fresh subkey. Inside a traced scope (see key_scope) the key chain
+    derives from the scope's (possibly tracer) key so compiled programs get a
+    per-call key argument instead of a baked constant."""
+    if _STATE.override is not None:
+        _STATE.override, sub = jax.random.split(_STATE.override)
+        return sub
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def get_key():
+    return _STATE.key
+
+
+class key_scope:
+    """Route next_key() to derive from `key` (used when tracing jitted
+    programs that sample — dropout under hybridize)."""
+
+    def __init__(self, key):
+        self._key = key
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = _STATE.override
+        _STATE.override = self._key
+        return self
+
+    def __exit__(self, *a):
+        _STATE.override = self._saved
+        return False

@@ -1,0 +1,186 @@
+"""RecordIO: binary packed record format.
+
+Parity with reference `python/mxnet/recordio.py` + dmlc-core's recordio
+stream (`src/io/image_recordio.h`, docs/faq/recordio.md). Binary-compatible
+with the reference format:
+
+  [kMagic:4bytes][lrecord:4bytes][data][pad to 4-byte boundary] ...
+
+where lrecord encodes cflag (3 bits) | length (29 bits) for records larger
+than the chunk split; IRHeader packs (flag, label, id, id2) ahead of image
+payloads (`pack`/`unpack`/`pack_img`/`unpack_img`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fid = None
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+
+    def close(self):
+        if self.fid is not None and not self.fid.closed:
+            self.fid.close()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        if not self.writable:
+            d["_pos"] = self.fid.tell() if self.fid and not self.fid.closed else 0
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        if not self.writable:
+            self.fid.seek(pos)
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        data = struct.pack("<II", _kMagic, len(buf))
+        self.fid.write(data)
+        self.fid.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.fid.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _kMagic:
+            raise MXNetError("Invalid RecordIO magic number")
+        length = lrec & ((1 << 29) - 1)
+        buf = self.fid.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fid.read(pad)
+        return buf
+
+    def tell(self):
+        return self.fid.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.fid.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record IO with a .idx sidecar (reference
+    MXIndexedRecordIO; .idx format: "key\\tposition\\n")."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+            self.idx = dict(self.idx)
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.fid.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.fid.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+def pack(header, s):
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        header = header._replace(flag=0, label=float(header.label))
+        return struct.pack(_IR_FORMAT, header.flag, header.label,
+                           header.id, header.id2) + s
+    label = np.asarray(header.label, dtype=np.float32)
+    header = header._replace(flag=label.size, label=0.0)
+    return struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                       header.id2) + label.tobytes() + s
+
+
+def unpack(s):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    from .image.codec import imencode
+    buf = imencode(img, img_fmt, quality)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=-1):
+    header, s = unpack(s)
+    from .image.codec import imdecode_np
+    img = imdecode_np(s, iscolor)
+    return header, img

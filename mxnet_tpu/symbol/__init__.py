@@ -1,0 +1,18 @@
+"""`mx.sym` namespace (reference `python/mxnet/symbol/`)."""
+from .symbol import (Symbol, var, Variable, Group, load, load_json, create)
+from .register import populate as _populate
+
+_populate(globals())
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return globals()["_zeros"](shape=shape, dtype=dtype or "float32", **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return globals()["_ones"](shape=shape, dtype=dtype or "float32", **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return globals()["_arange"](start=start, stop=stop, step=step,
+                                repeat=repeat, dtype=dtype or "float32", **kwargs)

@@ -1,0 +1,156 @@
+"""Parameter-shape inference for symbolic binding.
+
+The reference infers unknown argument shapes with per-op FInferShape inside
+the InferShape graph pass (`src/executor/infer_graph_attr_pass.cc`). Here,
+output shapes come for free from `jax.eval_shape` over each op's fcompute;
+this module supplies the one missing piece — filling the shapes of
+*parameter* inputs (weights/bias/gamma/...) from the data shape and op
+attrs, for every parameter-bearing op.
+
+Each filler: fn(params, in_shapes) -> in_shapes with None entries filled.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+_FILLERS = {}
+
+
+def filler(*names):
+    def deco(fn):
+        for n in names:
+            _FILLERS[n] = fn
+        return fn
+    return deco
+
+
+def fill_param_shapes(op_name, params, in_shapes):
+    if all(s is not None for s in in_shapes):
+        return in_shapes
+    fn = _FILLERS.get(op_name)
+    if fn is None:
+        # default: unknown inputs take the first known input's shape
+        # (covers elemwise ops with unbound vars)
+        known = next((s for s in in_shapes if s is not None), None)
+        if known is None:
+            raise MXNetError("cannot infer shapes for op %s" % op_name)
+        return [known if s is None else s for s in in_shapes]
+    return fn(dict(params, _op_name=op_name), list(in_shapes))
+
+
+@filler("FullyConnected")
+def _fc(params, shapes):
+    data = shapes[0]
+    nh = params["num_hidden"]
+    d = int(np.prod(data[1:])) if params.get("flatten", True) else data[-1]
+    if shapes[1] is None:
+        shapes[1] = (nh, d)
+    if len(shapes) > 2 and shapes[2] is None:
+        shapes[2] = (nh,)
+    return shapes
+
+
+@filler("Convolution")
+def _conv(params, shapes):
+    data = shapes[0]
+    nf = params["num_filter"]
+    g = params.get("num_group", 1)
+    kernel = tuple(params["kernel"])
+    if shapes[1] is None:
+        shapes[1] = (nf, data[1] // g) + kernel
+    if len(shapes) > 2 and shapes[2] is None:
+        shapes[2] = (nf,)
+    return shapes
+
+
+@filler("Deconvolution")
+def _deconv(params, shapes):
+    data = shapes[0]
+    nf = params["num_filter"]
+    g = params.get("num_group", 1)
+    kernel = tuple(params["kernel"])
+    if shapes[1] is None:
+        shapes[1] = (data[1], nf // g) + kernel
+    if len(shapes) > 2 and shapes[2] is None:
+        shapes[2] = (nf,)
+    return shapes
+
+
+@filler("BatchNorm", "BatchNorm_v1")
+def _bn(params, shapes):
+    c = shapes[0][params.get("axis", 1)]
+    for i in range(1, 5):
+        if i < len(shapes) and shapes[i] is None:
+            shapes[i] = (c,)
+    return shapes
+
+
+@filler("LayerNorm")
+def _ln(params, shapes):
+    c = shapes[0][params.get("axis", -1)]
+    for i in (1, 2):
+        if shapes[i] is None:
+            shapes[i] = (c,)
+    return shapes
+
+
+@filler("InstanceNorm")
+def _in(params, shapes):
+    c = shapes[0][1]
+    for i in (1, 2):
+        if shapes[i] is None:
+            shapes[i] = (c,)
+    return shapes
+
+
+@filler("Embedding")
+def _emb(params, shapes):
+    if shapes[1] is None:
+        shapes[1] = (params["input_dim"], params["output_dim"])
+    return shapes
+
+
+@filler("LeakyReLU")
+def _prelu(params, shapes):
+    if len(shapes) > 1 and shapes[1] is None:
+        data = shapes[0]
+        shapes[1] = (data[1] if len(data) > 1 else data[0],)
+    return shapes
+
+
+@filler("RNN")
+def _rnn(params, shapes):
+    from ..ops.nn import rnn_param_size
+    data = shapes[0]
+    T, B, I = data
+    H = params["state_size"]
+    L = params.get("num_layers", 1)
+    bidir = params.get("bidirectional", False)
+    d = 2 if bidir else 1
+    if shapes[1] is None:
+        shapes[1] = (rnn_param_size(L, I, H, bidir, params["mode"]),)
+    if shapes[2] is None:
+        shapes[2] = (L * d, B, H)
+    if len(shapes) > 3 and shapes[3] is None:
+        shapes[3] = (L * d, B, H)
+    return shapes
+
+
+@filler("SoftmaxOutput", "Softmax", "LinearRegressionOutput",
+        "LogisticRegressionOutput", "MAERegressionOutput", "SVMOutput")
+def _output_head(params, shapes):
+    data = shapes[0]
+    if shapes[1] is None:
+        if params.get("multi_output"):
+            shapes[1] = (data[0],) + tuple(data[2:])
+        elif len(data) >= 2:
+            # label shape: data shape sans class axis for softmax; same shape
+            # for regression heads
+            name_hint = params.get("_op_name", "")
+            shapes[1] = tuple(data[:-1]) if name_hint in (
+                "SoftmaxOutput", "Softmax", "SVMOutput") else tuple(data)
+        else:
+            shapes[1] = tuple(data)
+    return shapes

@@ -1,0 +1,41 @@
+"""Auto-generate symbol-level op functions (reference
+`python/mxnet/symbol/register.py` generates them from the C op registry)."""
+from __future__ import annotations
+
+from ..ops.registry import _OPS
+from .symbol import Symbol, create
+
+__all__ = ["populate"]
+
+
+def _make_fn(name):
+    def fn(*args, **kwargs):
+        sym_name = kwargs.pop("name", None)
+        inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
+                inputs.extend(a)
+            else:
+                raise TypeError("%s: positional args must be Symbols" % name)
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                inputs.append(v)
+            elif k == "attr" and isinstance(v, dict):
+                attrs.setdefault("__attrs__", {}).update(v)
+            else:
+                attrs[k] = v
+        return create(name, inputs, attrs, name=sym_name)
+
+    fn.__name__ = name
+    return fn
+
+
+def populate(namespace):
+    for name, op in list(_OPS.items()):
+        if not op.visible:
+            continue
+        if name not in namespace:
+            namespace[name] = _make_fn(name)

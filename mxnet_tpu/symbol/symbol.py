@@ -1,0 +1,615 @@
+"""Symbol: the declarative graph IR.
+
+Parity with reference `python/mxnet/symbol/symbol.py` and the NNVM Symbol/
+Graph substrate (`3rdparty/nnvm`, SURVEY.md §2.17). TPU-native design: a
+Symbol is a lightweight DAG over registered ops; binding does not run NNVM
+passes (PlanMemory/PlaceDevice/...) — instead the whole graph is traced into
+ONE jitted XLA computation (see `mxnet_tpu/executor.py`), which is the
+reference's own end-state for hot paths (CachedOp bulk execution,
+`src/imperative/cached_op.cc:342`).
+
+Supports: compose ops, free variables, Group, attr scoping (`__ctx_group__`
+etc. flow into sharding hints), infer_shape/infer_type, tojson/load,
+simple_bind/bind/eval, arithmetic operators.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..attribute import AttrScope
+from ..name import NameManager
+from ..ops.registry import get_op, _OPS
+from . import infer as _infer
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
+           "ones", "arange"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_aux_mark")
+
+    def __init__(self, op, name, attrs, inputs):
+        self.op = op                    # op name or None for variable
+        self.name = name
+        self.attrs = attrs or {}
+        self.inputs = inputs            # list[(node, out_idx)]
+        if op is None:
+            self.num_outputs = 1
+        else:
+            self.num_outputs = get_op(op).n_out(attrs or {})
+        self._aux_mark = False
+
+    def is_var(self):
+        return self.op is None
+
+
+class Symbol:
+    def __init__(self, outputs):
+        # list of (node, out_index)
+        self._outputs = list(outputs)
+
+    # -- composition -----------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        s = self.__copy__()
+        s._compose(*args, **kwargs)
+        return s
+
+    def _compose(self, *args, **kwargs):
+        raise NotImplementedError("partial compose not supported; pass inputs "
+                                  "at op construction")
+
+    def __copy__(self):
+        return Symbol(self._outputs)
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # -- outputs ---------------------------------------------------------
+    @property
+    def name(self):
+        node, idx = self._outputs[0]
+        if len(self._outputs) > 1:
+            return None
+        if node.num_outputs == 1:
+            return node.name
+        return _output_name(node, idx)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError("Cannot find output %s" % index)
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def get_internals(self):
+        nodes = self._topo_nodes()
+        outs = []
+        for n in nodes:
+            for i in range(n.num_outputs):
+                outs.append((n, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node, _ = self._outputs[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- graph walk ------------------------------------------------------
+    def _topo_nodes(self):
+        seen = {}
+        order = []
+
+        def visit(node):
+            stack = [(node, False)]
+            while stack:
+                n, processed = stack.pop()
+                if processed:
+                    order.append(n)
+                    continue
+                if id(n) in seen:
+                    continue
+                seen[id(n)] = n
+                stack.append((n, True))
+                for (inp, _) in reversed(n.inputs):
+                    if id(inp) not in seen:
+                        stack.append((inp, False))
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _mark_aux(self):
+        """Variables consumed at an op's mutate_aux positions are auxiliary
+        states (reference ListAuxiliaryStates)."""
+        for n in self._topo_nodes():
+            if n.is_var() or n.op not in _OPS:
+                continue
+            op = get_op(n.op)
+            for ai in op.mutate_aux:
+                if ai < len(n.inputs) and n.inputs[ai][0].is_var():
+                    n.inputs[ai][0]._aux_mark = True
+
+    def list_arguments(self):
+        self._mark_aux()
+        return [n.name for n in self._topo_nodes()
+                if n.is_var() and not n._aux_mark]
+
+    def list_auxiliary_states(self):
+        self._mark_aux()
+        return [n.name for n in self._topo_nodes() if n.is_var() and n._aux_mark]
+
+    def list_outputs(self):
+        outs = []
+        for node, idx in self._outputs:
+            if node.num_outputs == 1:
+                outs.append(node.name + "_output" if not node.is_var() else node.name)
+            else:
+                outs.append(_output_name(node, idx) + "_output")
+        return outs
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_var()]
+
+    # -- attributes ------------------------------------------------------
+    def attr(self, key):
+        node, _ = self._outputs[0]
+        v = node.attrs.get("__attrs__", {}).get(key)
+        return str(v) if v is not None else None
+
+    def attr_dict(self):
+        ret = {}
+        for n in self._topo_nodes():
+            ad = dict(n.attrs.get("__attrs__", {}))
+            if ad:
+                ret[n.name] = {k: str(v) for k, v in ad.items()}
+        return ret
+
+    def _set_attr(self, **kwargs):
+        node, _ = self._outputs[0]
+        node.attrs.setdefault("__attrs__", {}).update(kwargs)
+
+    # -- shape/type inference -------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        if args:
+            kwargs = dict(kwargs)
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    kwargs[n] = s
+        shapes, out_shapes, aux_shapes = _graph_infer(self, kwargs, partial=partial)
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux = [aux_shapes.get(n) for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dt = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    dt[n] = t
+        dt.update(kwargs)
+        default = np.float32
+        arg_types = [dtype_np(dt.get(n, default)) for n in arg_names]
+        out_types = [dtype_np(default)] * len(self._outputs)
+        aux_types = [dtype_np(default)] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- serialization (reference JSON graph format) --------------------
+    def tojson(self):
+        nodes = self._topo_nodes()
+        idmap = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_var() else n.op,
+                "name": n.name,
+                "attrs": _json_attrs(n.attrs),
+                "inputs": [[idmap[id(src)], oi, 0] for (src, oi) in n.inputs],
+            })
+        heads = [[idmap[id(node)], idx, 0] for node, idx in self._outputs]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_var()]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10201]}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- debug str -------------------------------------------------------
+    def debug_str(self):
+        lines = []
+        for n in self._topo_nodes():
+            if n.is_var():
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join(src.name for src, _ in n.inputs)
+                lines.append("Op:%s, Name=%s, Inputs=[%s]" % (n.op, n.name, ins))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+    # -- arithmetic ------------------------------------------------------
+    def __add__(self, other):
+        return _sym_binary(self, other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _sym_binary(self, other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _sym_binary(self, other, "broadcast_sub", "_rminus_scalar", True)
+
+    def __mul__(self, other):
+        return _sym_binary(self, other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _sym_binary(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _sym_binary(self, other, "broadcast_div", "_rdiv_scalar", True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        return _sym_binary(self, other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return create("negative", [self], {})
+
+    def __eq__(self, other):
+        return _sym_binary(self, other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return _sym_binary(self, other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return _sym_binary(self, other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return _sym_binary(self, other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return _sym_binary(self, other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return _sym_binary(self, other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # common tensor methods as symbol ops
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape", ())
+        return create("Reshape", [self], {"shape": tuple(shape)})
+
+    def astype(self, dtype):
+        return create("Cast", [self], {"dtype": dtype})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return create("transpose", [self], {"axes": axes or None})
+
+    def flatten(self):
+        return create("Flatten", [self], {})
+
+    def sum(self, axis=None, keepdims=False):
+        return create("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return create("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def softmax(self, axis=-1):
+        return create("softmax", [self], {"axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return create("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def expand_dims(self, axis):
+        return create("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return create("squeeze", [self], {"axis": axis})
+
+    # -- binding ---------------------------------------------------------
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict, group2ctx=group2ctx,
+                                    shared_exec=shared_exec,
+                                    shared_buffer=shared_buffer, **kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor.bind(self, ctx, args, args_grad=args_grad,
+                             grad_req=grad_req, aux_states=aux_states,
+                             group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        from ..context import cpu
+        ctx = ctx or cpu()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def grad(self, wrt):  # pragma: no cover - reference deprecated API
+        raise NotImplementedError("Symbol.grad is deprecated in the reference; "
+                                  "use simple_bind + backward")
+
+
+def _output_name(node, idx):
+    # multi-output ops name their outputs opname_output0.. (reference appends
+    # registered output names; we use indices)
+    return "%s%d" % (node.name, idx)
+
+
+def _json_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if k == "__attrs__":
+            continue
+        out[k] = json.dumps(v) if not isinstance(v, str) else v
+    return out
+
+
+def _sym_binary(lhs, rhs, op, scalar_op, reverse=False):
+    if isinstance(rhs, Symbol):
+        return create(op, [lhs, rhs], {})
+    if isinstance(rhs, (int, float)):
+        return create(scalar_op, [lhs], {"scalar": float(rhs)})
+    raise TypeError("type %s not supported" % str(type(rhs)))
+
+
+# Per-op input argument names (reference: each op's ListArguments). Used to
+# auto-create missing weight/bias/aux variables at compose time, matching the
+# reference behavior of `sym.FullyConnected(data, num_hidden=k)` creating
+# `{name}_weight`/`{name}_bias` vars.
+def _op_input_names(op_name, attrs):
+    no_bias = attrs.get("no_bias", False)
+    if op_name == "FullyConnected":
+        return ["data", "weight"] + ([] if no_bias else ["bias"])
+    if op_name in ("Convolution", "Deconvolution"):
+        return ["data", "weight"] + ([] if no_bias else ["bias"])
+    if op_name in ("BatchNorm", "BatchNorm_v1"):
+        return ["data", "gamma", "beta", "moving_mean", "moving_var"]
+    if op_name == "LayerNorm":
+        return ["data", "gamma", "beta"]
+    if op_name == "InstanceNorm":
+        return ["data", "gamma", "beta"]
+    if op_name == "Embedding":
+        return ["data", "weight"]
+    if op_name == "RNN":
+        names = ["data", "parameters", "state"]
+        if attrs.get("mode") == "lstm":
+            names.append("state_cell")
+        return names
+    if op_name == "LeakyReLU" and attrs.get("act_type") == "prelu":
+        return ["data", "gamma"]
+    if op_name in ("SoftmaxOutput", "Softmax", "LinearRegressionOutput",
+                   "LogisticRegressionOutput", "MAERegressionOutput",
+                   "SVMOutput"):
+        return ["data", "label"]
+    return None
+
+
+def create(op_name, input_syms, attrs, name=None):
+    """Create a Symbol applying op_name over input symbols."""
+    hint = op_name.lower().lstrip("_")
+    name = NameManager.current().get(name, hint)
+    scope_attrs = AttrScope.current().get(None)
+    node_attrs = dict(attrs)
+    if scope_attrs:
+        node_attrs["__attrs__"] = dict(scope_attrs)
+    inputs = []
+    for s in input_syms:
+        if isinstance(s, Symbol):
+            if len(s._outputs) == 1:
+                inputs.append(s._outputs[0])
+            else:
+                inputs.extend(s._outputs)
+        else:
+            raise TypeError("inputs must be Symbols, got %s" % type(s))
+    arg_names = _op_input_names(op_name, node_attrs)
+    if arg_names is not None and len(inputs) < len(arg_names):
+        for missing in arg_names[len(inputs):]:
+            suffix = "label" if missing == "label" else missing
+            vnode = _Node(None, "%s_%s" % (name, suffix), {}, [])
+            inputs.append((vnode, 0))
+    node = _Node(op_name, name, node_attrs, inputs)
+    return Symbol([(node, i) for i in range(node.num_outputs)])
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a free variable (reference symbol.var)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attrs = {}
+    scope_attrs = AttrScope.current().get(attr)
+    if scope_attrs:
+        attrs["__attrs__"] = dict(scope_attrs)
+    meta = attrs.setdefault("__attrs__", {})
+    if shape is not None:
+        meta["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        meta["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        meta["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        meta["__dtype__"] = str(np.dtype(dtype))
+    if init is not None:
+        meta["__init__"] = init.dumps() if hasattr(init, "dumps") else str(init)
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            meta[k] = str(v)
+    node = _Node(None, name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    outputs = []
+    for s in symbols:
+        outputs.extend(s._outputs)
+    return Symbol(outputs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    nodes = []
+    for jn in jnodes:
+        attrs = {}
+        for k, v in jn.get("attrs", {}).items():
+            try:
+                attrs[k] = json.loads(v)
+            except (ValueError, TypeError):
+                attrs[k] = v
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], {"__attrs__": attrs} if attrs else {}, [])
+        else:
+            inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+            node = _Node(jn["op"], jn["name"], attrs, inputs)
+        nodes.append(node)
+    outputs = [(nodes[i], oi) for i, oi, _ in data["heads"]]
+    return Symbol(outputs)
+
+
+# ---------------------------------------------------------------------------
+# graph shape inference over jax.eval_shape
+# ---------------------------------------------------------------------------
+def _graph_infer(sym, known_shapes, partial=False, type_dict=None):
+    """Returns (arg_shapes dict, out_shapes list, aux_shapes dict)."""
+    import jax
+
+    nodes = sym._topo_nodes()
+    sym._mark_aux()
+    type_dict = type_dict or {}
+    var_shape = {}
+    var_dtype = {}
+    for n in nodes:
+        if n.is_var():
+            meta = n.attrs.get("__attrs__", {})
+            s = known_shapes.get(n.name)
+            if s is None and "__shape__" in meta:
+                s = tuple(int(x) for x in meta["__shape__"].strip("()").split(",") if x.strip())
+            var_shape[n.name] = tuple(s) if s is not None else None
+            dt = type_dict.get(n.name) or meta.get("__dtype__")
+            var_dtype[n.name] = dtype_np(dt) if dt else None
+
+    avals = {}  # id(node) -> list of ShapeDtypeStruct per output
+
+    def aval_of(node, idx):
+        return avals[id(node)][idx]
+
+    for n in nodes:
+        if n.is_var():
+            s = var_shape[n.name]
+            dt = var_dtype[n.name] or np.float32
+            avals[id(n)] = [jax.ShapeDtypeStruct(s, dt) if s is not None else None]
+            continue
+        op = get_op(n.op)
+        in_avals = []
+        unknown = []
+        for i, (src, oi) in enumerate(n.inputs):
+            a = avals[id(src)][oi]
+            in_avals.append(a)
+            if a is None:
+                unknown.append(i)
+        if unknown:
+            in_shapes = [a.shape if a is not None else None for a in in_avals]
+            try:
+                filled = _infer.fill_param_shapes(n.op, _clean_attrs(n.attrs), in_shapes)
+            except MXNetError:
+                if partial:
+                    avals[id(n)] = [None] * n.num_outputs
+                    continue
+                raise
+            ref_dtype = next((a.dtype for a in in_avals if a is not None), np.float32)
+            for i in unknown:
+                if filled[i] is None:
+                    if partial:
+                        filled[i] = None
+                    else:
+                        raise MXNetError("cannot infer shape of input %d to %s"
+                                         % (i, n.name))
+                src, oi = n.inputs[i]
+                dt = var_dtype.get(src.name) or ref_dtype
+                if filled[i] is not None and src.is_var():
+                    var_shape[src.name] = tuple(filled[i])
+                    avals[id(src)] = [jax.ShapeDtypeStruct(tuple(filled[i]), dt)]
+                in_avals[i] = avals[id(src)][0] if src.is_var() else None
+        if any(a is None for a in in_avals):
+            avals[id(n)] = [None] * n.num_outputs
+            continue
+        params = _eval_params(n, op)
+        out = jax.eval_shape(lambda *xs: op.fcompute(params, *xs), *in_avals)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        avals[id(n)] = list(out)
+
+    out_shapes = []
+    for node, idx in sym._outputs:
+        a = avals[id(node)][idx]
+        out_shapes.append(tuple(a.shape) if a is not None else None)
+    aux_names = set(sym.list_auxiliary_states())
+    arg_shapes = {k: v for k, v in var_shape.items() if k not in aux_names}
+    aux_shapes = {k: v for k, v in var_shape.items() if k in aux_names}
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def _clean_attrs(attrs):
+    return {k: v for k, v in attrs.items() if k != "__attrs__"}
+
+
+def _eval_params(node, op):
+    params = _clean_attrs(node.attrs)
+    if op.need_train_flag:
+        params.setdefault("_is_train", False)
+    if op.need_rng:
+        import jax
+        params.setdefault("_rng_key", jax.random.PRNGKey(0))
+    return params

@@ -1,0 +1,361 @@
+"""Test harness helpers.
+
+Parity with reference `python/mxnet/test_utils.py`: assert_almost_equal
+(:470), check_numeric_gradient (:792), check_symbolic_forward (:925),
+check_symbolic_backward (:999), check_consistency (:1207, dtype/ctx
+cross-check), default_context, rand_ndarray, simple_forward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+from . import symbol as sym_mod
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "simple_forward", "check_speed"]
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    diff = np.abs(a - b)
+    tol = (atol or 0) + (rtol or 0) * np.abs(b)
+    violation = diff - tol
+    idx = np.unravel_index(np.argmax(violation), violation.shape) if a.size else ()
+    return idx, np.max(violation) if a.size else 0
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a, b = _np(a).astype(np.float64), _np(b).astype(np.float64)
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        idx, viol = find_max_violation(a, b, rtol, atol)
+        raise AssertionError(
+            "Items are not equal:\nError %f exceeds tolerance rtol=%f, atol=%f "
+            "at position %s.\n%s: %s\n%s: %s" %
+            (viol, rtol, atol, str(idx), names[0], str(a[idx] if idx else a),
+             names[1], str(b[idx] if idx else b)))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(_np(a), _np(b), rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    data = np.random.uniform(-1, 1, size=shape).astype(dtype or np.float32)
+    arr = array(data, ctx=ctx or default_context(), dtype=dtype)
+    if stype != "default":
+        from .ndarray import sparse
+        return sparse.cast_storage(arr, stype)
+    return arr
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def _parse_location(sym, location, ctx, dtype=np.float32):
+    if isinstance(location, dict):
+        return {k: array(v, ctx=ctx, dtype=getattr(v, "dtype", dtype))
+                if not isinstance(v, NDArray) else v
+                for k, v in location.items()}
+    return {k: array(v, ctx=ctx, dtype=getattr(v, "dtype", dtype))
+            if not isinstance(v, NDArray) else v
+            for k, v in zip(sym.list_arguments(), location)}
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    inputs = {k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+              for k, v in inputs.items()}
+    exe = sym.bind(ctx, inputs)
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=np.float32):
+    """Reference test_utils.py:925."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    aux = None
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            aux = {k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+                   for k, v in aux_states.items()}
+        else:
+            aux = {k: array(v, ctx=ctx)
+                   for k, v in zip(sym.list_auxiliary_states(), aux_states)}
+    exe = sym.bind(ctx, location, aux_states=aux)
+    exe.forward(is_train=False)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol, atol or 1e-20, equal_nan=equal_nan)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, equal_nan=False, dtype=np.float32):
+    """Reference test_utils.py:999."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    args_grad = {k: array(np.zeros(v.shape), ctx=ctx)
+                 for k, v in location.items() if k in expected or grad_req != "null"}
+    aux = None
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            aux = {k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+                   for k, v in aux_states.items()}
+        else:
+            aux = {k: array(v, ctx=ctx)
+                   for k, v in zip(sym.list_auxiliary_states(), aux_states)}
+    req = grad_req if isinstance(grad_req, str) else dict(grad_req)
+    exe = sym.bind(ctx, location, args_grad=args_grad, grad_req=req,
+                   aux_states=aux)
+    if isinstance(out_grads, (list, tuple)):
+        out_grads = [array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+                     for v in out_grads]
+    elif isinstance(out_grads, dict):
+        out_grads = [array(v, ctx=ctx) for v in out_grads.values()]
+    exe.forward(is_train=True)
+    exe.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in exe.grad_dict.items() if v is not None}
+    for name, exp in expected.items():
+        assert_almost_equal(grads[name], exp, rtol, atol or 1e-20,
+                            names=("grad(%s)" % name, "expected"),
+                            equal_nan=equal_nan)
+    return grads
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=np.float32):
+    """Finite-difference gradients over the executor's scalar-sum output."""
+    approx_grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().astype(np.float64)
+        grad = np.zeros_like(base)
+        flat = base.reshape(-1)
+        gflat = grad.reshape(-1)
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps / 2
+            executor.arg_dict[name][:] = base.astype(dtype)
+            executor.forward(is_train=use_forward_train)
+            fplus = sum(o.asnumpy().astype(np.float64).sum() for o in executor.outputs)
+            flat[i] = old - eps / 2
+            executor.arg_dict[name][:] = base.astype(dtype)
+            executor.forward(is_train=use_forward_train)
+            fminus = sum(o.asnumpy().astype(np.float64).sum() for o in executor.outputs)
+            gflat[i] = (fplus - fminus) / eps
+            flat[i] = old
+        executor.arg_dict[name][:] = base.astype(dtype)
+        approx_grads[name] = grad
+    return approx_grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None, dtype=np.float32):
+    """Reference test_utils.py:792: compare autograd vs finite differences.
+
+    Uses a random-projection scalar head like the reference (sum-proxy)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    if grad_nodes is None:
+        grad_nodes = [k for k in location if True]
+
+    aux = None
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            aux = {k: array(v, ctx=ctx) for k, v in aux_states.items()}
+        else:
+            aux = {k: array(v, ctx=ctx)
+                   for k, v in zip(sym.list_auxiliary_states(), aux_states)}
+
+    args_grad = {k: array(np.zeros(location[k].shape), ctx=ctx)
+                 for k in grad_nodes if k in location}
+    exe = sym.bind(ctx, location, args_grad=args_grad, grad_req="write",
+                   aux_states=aux)
+    exe.forward(is_train=use_forward_train)
+    exe.backward()
+    sym_grads = {k: v.asnumpy() for k, v in exe.grad_dict.items()
+                 if v is not None}
+
+    fd_loc = {k: v for k, v in location.items() if k in grad_nodes}
+    fd = numeric_grad(exe, fd_loc, eps=numeric_eps,
+                      use_forward_train=use_forward_train, dtype=dtype)
+    for name in fd:
+        if name not in sym_grads:
+            continue
+        assert_almost_equal(fd[name], sym_grads[name], rtol, atol or 1e-20,
+                            names=("numeric(%s)" % name, "symbolic(%s)" % name))
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None, equal_nan=False):
+    """Reference test_utils.py:1207: run the same symbol under several
+    ctx/dtype combos and cross-check outputs and gradients. On TPU this is
+    the kernel-parity harness between cpu (XLA:CPU) and tpu backends and
+    between fp32/bf16/fp16."""
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+               np.dtype(np.int32): 0, np.dtype(np.int64): 0}
+        try:
+            import jax.numpy as jnp
+            tol[np.dtype(jnp.bfloat16)] = 5e-2
+        except Exception:
+            pass
+    elif isinstance(tol, float):
+        tol = {k: tol for k in (np.dtype(np.float16), np.dtype(np.float32),
+                                np.dtype(np.float64))}
+
+    assert len(ctx_list) > 1
+    if isinstance(sym, sym_mod.Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+
+    output_points = None
+    exe_list = []
+    arg_np = None
+    for s, ctx_spec in zip(sym, ctx_list):
+        ctx_spec = dict(ctx_spec)
+        ctx = ctx_spec.pop("ctx", default_context())
+        type_dict = ctx_spec.pop("type_dict", {})
+        shapes = ctx_spec
+        exe = s.simple_bind(ctx, grad_req=grad_req, type_dict=type_dict, **shapes)
+        if arg_np is None:
+            arg_np = {}
+            for name, arr in exe.arg_dict.items():
+                arg_np[name] = np.random.normal(size=arr.shape,
+                                                scale=scale).astype(np.float64)
+            if arg_params:
+                for n, v in arg_params.items():
+                    arg_np[n] = _np(v).astype(np.float64)
+        for name, arr in exe.arg_dict.items():
+            arr[:] = arg_np[name].astype(arr.dtype)
+        if aux_params:
+            for n, v in aux_params.items():
+                if n in exe.aux_dict:
+                    exe.aux_dict[n][:] = v
+        exe_list.append(exe)
+
+    dtypes = [np.dtype(list(dict(c).get("type_dict", {}).values())[0])
+              if dict(c).get("type_dict") else np.dtype(np.float32)
+              for c in ctx_list]
+    max_idx = int(np.argmax([np.finfo(d).precision if np.issubdtype(d, np.floating)
+                             else 0 for d in dtypes]))
+
+    for exe in exe_list:
+        exe.forward(is_train=False)
+    gt_outputs = ground_truth or [o.asnumpy() for o in exe_list[max_idx].outputs]
+    for i, exe in enumerate(exe_list):
+        if i == max_idx:
+            continue
+        t = tol.get(dtypes[i], 1e-3)
+        for out, gt in zip(exe.outputs, gt_outputs):
+            try:
+                assert_almost_equal(out.asnumpy(), gt, rtol=t, atol=t)
+            except AssertionError:
+                if raise_on_err:
+                    raise
+    if grad_req != "null":
+        for exe in exe_list:
+            exe.forward(is_train=True)
+            exe.backward([array(np.ones(o.shape) * scale, ctx=o.ctx,
+                                dtype=o.dtype) for o in exe.outputs])
+        gt_grads = {k: v.asnumpy() for k, v in exe_list[max_idx].grad_dict.items()
+                    if v is not None}
+        for i, exe in enumerate(exe_list):
+            if i == max_idx:
+                continue
+            t = tol.get(dtypes[i], 1e-3)
+            for name, g in exe.grad_dict.items():
+                if g is None or name not in gt_grads:
+                    continue
+                try:
+                    assert_almost_equal(g.asnumpy(), gt_grads[name], rtol=t, atol=t)
+                except AssertionError:
+                    if raise_on_err:
+                        raise
+    return gt_outputs
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
+                typ="whole", **kwargs):
+    """Reference test_utils.py:1133 op benchmark helper."""
+    import time
+    ctx = ctx or default_context()
+    if location is None:
+        arg_shapes, _, _ = sym.infer_shape(**kwargs)
+        location = {n: np.random.normal(size=s, scale=1.0)
+                    for n, s in zip(sym.list_arguments(), arg_shapes)}
+    location = _parse_location(sym, location, ctx)
+    exe = sym.simple_bind(ctx, grad_req=grad_req,
+                          **{k: v.shape for k, v in location.items()})
+    for name, arr in location.items():
+        exe.arg_dict[name][:] = arr
+    if typ == "whole":
+        exe.forward_backward()
+        from .ndarray import waitall
+        waitall()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward_backward()
+        waitall()
+        return (time.time() - tic) / N
+    elif typ == "forward":
+        exe.forward(is_train=False)
+        from .ndarray import waitall
+        waitall()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=False)
+        waitall()
+        return (time.time() - tic) / N
+    raise ValueError("typ can only be whole or forward.")

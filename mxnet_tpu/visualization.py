@@ -1,0 +1,139 @@
+"""Network visualisation (reference `python/mxnet/visualization.py`):
+print_summary + plot_network (graphviz optional)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Reference visualization.py print_summary: layer table with params."""
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape_partial(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in set(conf["arg_nodes"]):
+                    is_param = input_node["op"] == "null" and not (
+                        input_name.endswith("_weight") or input_name.endswith("_bias")
+                        or input_name.endswith("_gamma") or input_name.endswith("_beta")
+                        or input_name.endswith("_moving_mean")
+                        or input_name.endswith("_moving_var"))
+                    if input_node["op"] != "null" or is_param:
+                        pre_node.append(input_name)
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            num_filter = int(_attr(attrs, "num_filter", 0))
+            kernel = _parse_tuple(_attr(attrs, "kernel", "()"))
+            num_group = int(_attr(attrs, "num_group", 1))
+            if pre_filter:
+                cur_param = num_filter * pre_filter // num_group
+                for k in kernel:
+                    cur_param *= k
+                cur_param += num_filter
+        elif op == "FullyConnected":
+            cur_param = int(_attr(attrs, "num_hidden", 0))
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [node["name"] + "(" + op + ")",
+                  "x".join(str(x) for x in (out_shape or ())),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    for node in nodes:
+        out_shape = None
+        op = node["op"]
+        if op == "null":
+            continue
+        if shape is not None:
+            key = node["name"] + "_output"
+            if key in shape_dict:
+                out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        print("_" * line_length)
+    print("Total params: {params}".format(params=total_params[0]))
+    print("_" * line_length)
+
+
+def _attr(attrs, key, default):
+    v = attrs.get(key, default)
+    if isinstance(v, str):
+        try:
+            v = json.loads(v)
+        except ValueError:
+            pass
+    return v
+
+
+def _parse_tuple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return tuple(int(x) for x in str(v).strip("()").split(",") if x.strip())
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires graphviz; install it or use "
+                         "print_summary") from None
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and any(name.endswith(s) for s in
+                                    ("_weight", "_bias", "_gamma", "_beta",
+                                     "_moving_mean", "_moving_var")):
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op), shape="box")
+            for item in node["inputs"]:
+                src = nodes[item[0]]["name"]
+                if hide_weights and any(src.endswith(s) for s in
+                                        ("_weight", "_bias", "_gamma", "_beta",
+                                         "_moving_mean", "_moving_var")):
+                    continue
+                dot.edge(src, name)
+    return dot

@@ -1,0 +1,37 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference CI trick of testing distributed semantics on one
+machine (`ci/docker/runtime_functions.sh:551`): multi-chip sharding tests
+use --xla_force_host_platform_device_count=8 host devices.
+
+Must run before jax initializes any backend: forces the cpu platform and
+drops the axon TPU plugin registration (tests never touch the real chip).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    # sitecustomize may have imported jax already (axon TPU plugin), so the
+    # env var alone is too late — update the live config before any backend
+    # initializes.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,169 @@
+"""Autograd tests (modeled on reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(mx.nd.log(x) * 2)  # = x^2
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0], np.float32))
+
+
+def test_multiple_inputs_and_reuse():
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = a * b + a  # dc/da = b + 1, dc/db = a
+    c.backward()
+    assert_almost_equal(a.grad, np.array([4.0], np.float32))
+    assert_almost_equal(b.grad, np.array([2.0], np.float32))
+
+
+def test_grad_add_req():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = x.sum()
+        y.backward()
+    assert_almost_equal(x.grad, np.full(2, 3.0, np.float32))
+
+
+def test_detach_and_stop_gradient():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = mx.nd.BlockGrad(y) + x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([1.0], np.float32))
+
+
+def test_is_recording_training():
+    assert not ag.is_recording()
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+    with ag.record(train_mode=False):
+        assert ag.is_recording()
+        assert not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
+    with ag.predict_mode():
+        assert not ag.is_training()
+
+
+def test_no_tape_error():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    y = x * 2  # outside record
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_grad_function():
+    x2 = mx.nd.array([1.0, 2.0, 3.0])
+    x2.attach_grad()
+    with ag.record():
+        y = mx.nd.sum(x2 * x2 * x2)
+    grads = ag.grad(y, [x2])
+    assert_almost_equal(grads[0], 3 * x2.asnumpy() ** 2, rtol=1e-4)
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = mx.nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = mx.nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4)
+
+
+def test_nn_layer_grads():
+    # conv + pooling + fc chained, numeric sanity via finite differences
+    x_np = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    w_np = np.random.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    w = mx.nd.array(w_np)
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = mx.nd.Convolution(x, w, kernel=(3, 3), num_filter=4, no_bias=True)
+        z = mx.nd.relu(y).sum()
+    z.backward()
+    # finite diff on one weight element
+    eps = 1e-2
+    w_pert = w_np.copy()
+    w_pert[0, 0, 0, 0] += eps
+    z1 = np.maximum(
+        mx.nd.Convolution(mx.nd.array(x_np), mx.nd.array(w_pert), kernel=(3, 3),
+                          num_filter=4, no_bias=True).asnumpy(), 0).sum()
+    w_pert[0, 0, 0, 0] -= 2 * eps
+    z2 = np.maximum(
+        mx.nd.Convolution(mx.nd.array(x_np), mx.nd.array(w_pert), kernel=(3, 3),
+                          num_filter=4, no_bias=True).asnumpy(), 0).sum()
+    fd = (z1 - z2) / (2 * eps)
+    assert abs(w.grad.asnumpy()[0, 0, 0, 0] - fd) < 5e-2
+
+
+def test_retain_graph():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert_almost_equal(x.grad, g1)  # write req overwrites
+
+
+def test_mark_variables():
+    x = mx.nd.array([2.0])
+    g = mx.nd.zeros((1,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = x * 5
+    y.backward()
+    assert_almost_equal(g, np.array([5.0], np.float32))

@@ -1,0 +1,246 @@
+"""NDArray tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    x = mx.nd.zeros((3, 4))
+    assert x.shape == (3, 4)
+    assert x.dtype == np.float32
+    assert x.size == 12
+    y = mx.nd.ones((2,), dtype="int32")
+    assert y.dtype == np.int32
+    z = mx.nd.full((2, 2), 7)
+    assert (z.asnumpy() == 7).all()
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert_almost_equal(a, np.array([[1, 2], [3, 4]]))
+    r = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(r, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_elementwise_arith():
+    a_np = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    b_np = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal(a + b, a_np + b_np)
+    assert_almost_equal(a - b, a_np - b_np)
+    assert_almost_equal(a * b, a_np * b_np)
+    assert_almost_equal(a / b, a_np / b_np, rtol=1e-4)
+    assert_almost_equal(a + 2, a_np + 2)
+    assert_almost_equal(2 - a, 2 - a_np)
+    assert_almost_equal(a * 0.5, a_np * 0.5)
+    assert_almost_equal(1.0 / (a + 3), 1.0 / (a_np + 3), rtol=1e-4)
+    assert_almost_equal(-a, -a_np)
+    assert_almost_equal(abs(a), np.abs(a_np))
+    assert_almost_equal((a ** 2), a_np ** 2, rtol=1e-4)
+
+
+def test_inplace_ops():
+    a_np = np.ones((3, 3), np.float32)
+    a = mx.nd.array(a_np)
+    a += 2
+    assert (a.asnumpy() == 3).all()
+    a *= 2
+    assert (a.asnumpy() == 6).all()
+    a -= 1
+    assert (a.asnumpy() == 5).all()
+    a /= 5
+    assert (a.asnumpy() == 1).all()
+
+
+def test_broadcast():
+    a = mx.nd.ones((3, 1))
+    b = mx.nd.ones((1, 4)) * 2
+    c = a + b
+    assert c.shape == (3, 4)
+    assert (c.asnumpy() == 3).all()
+    d = mx.nd.broadcast_to(a, shape=(3, 5))
+    assert d.shape == (3, 5)
+
+
+def test_comparisons():
+    a = mx.nd.array([1, 2, 3])
+    b = mx.nd.array([3, 2, 1])
+    assert_almost_equal(a == b, np.array([0, 1, 0], np.float32))
+    assert_almost_equal(a > b, np.array([0, 0, 1], np.float32))
+    assert_almost_equal(a <= b, np.array([1, 1, 0], np.float32))
+    assert_almost_equal(a != 2, np.array([1, 0, 1], np.float32))
+
+
+def test_indexing():
+    a_np = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a[1], a_np[1])
+    assert_almost_equal(a[1:3], a_np[1:3])
+    assert_almost_equal(a[1, 2:4], a_np[1, 2:4])
+    assert a[2, 3].asscalar() == a_np[2, 3]
+    a[0] = 100
+    a_np[0] = 100
+    assert_almost_equal(a, a_np)
+    a[1:3, 0] = -1
+    a_np[1:3, 0] = -1
+    assert_almost_equal(a, a_np)
+    a[:] = 0
+    assert (a.asnumpy() == 0).all()
+
+
+def test_reshape_transpose():
+    a_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(a_np)
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert_almost_equal(a.T, a_np.T)
+    assert_almost_equal(a.transpose((2, 0, 1)), a_np.transpose(2, 0, 1))
+    assert_almost_equal(a.swapaxes(0, 2), a_np.swapaxes(0, 2))
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert mx.nd.ones((2, 1, 3)).squeeze(axis=1).shape == (2, 3)
+
+
+def test_reductions():
+    a_np = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a.sum(), a_np.sum(), rtol=1e-4)
+    assert_almost_equal(a.sum(axis=1), a_np.sum(1), rtol=1e-4)
+    assert_almost_equal(a.mean(axis=(0, 2)), a_np.mean((0, 2)), rtol=1e-4)
+    assert_almost_equal(a.max(axis=2, keepdims=True), a_np.max(2, keepdims=True))
+    assert_almost_equal(a.min(), a_np.min())
+    assert_almost_equal(mx.nd.sum(a, axis=0, exclude=True),
+                        a_np.sum(axis=(1, 2)), rtol=1e-4)
+    assert_almost_equal(a.norm(), np.sqrt((a_np ** 2).sum()), rtol=1e-4)
+    assert_almost_equal(a.argmax(axis=1), a_np.argmax(1).astype(np.float32))
+
+
+def test_dot():
+    a_np = np.random.uniform(size=(4, 5)).astype(np.float32)
+    b_np = np.random.uniform(size=(5, 6)).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal(mx.nd.dot(a, b), a_np @ b_np, rtol=1e-4)
+    assert_almost_equal(mx.nd.dot(a, a, transpose_b=True), a_np @ a_np.T, rtol=1e-4)
+    bd_a = mx.nd.array(np.random.uniform(size=(3, 4, 5)).astype(np.float32))
+    bd_b = mx.nd.array(np.random.uniform(size=(3, 5, 2)).astype(np.float32))
+    assert_almost_equal(mx.nd.batch_dot(bd_a, bd_b),
+                        np.matmul(bd_a.asnumpy(), bd_b.asnumpy()), rtol=1e-4)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((2, 3)) * 2
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = mx.nd.Concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    parts = mx.nd.split(c2, num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_one_hot():
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = mx.nd.array([0, 2], dtype="int32")
+    out = mx.nd.take(w, idx)
+    assert_almost_equal(out, w.asnumpy()[[0, 2]])
+    oh = mx.nd.one_hot(idx, 4)
+    assert_almost_equal(oh, np.eye(4, dtype=np.float32)[[0, 2]])
+    picked = mx.nd.pick(w, mx.nd.array([1, 0, 2, 1]), axis=1)
+    assert_almost_equal(picked, np.array([1, 3, 8, 10], np.float32))
+
+
+def test_sort_topk():
+    a_np = np.random.uniform(size=(3, 8)).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(mx.nd.sort(a), np.sort(a_np))
+    assert_almost_equal(mx.nd.argsort(a), np.argsort(a_np).astype(np.float32))
+    topk = mx.nd.topk(a, k=3)
+    expect = np.argsort(-a_np)[:, :3].astype(np.float32)
+    assert_almost_equal(topk, expect)
+    vals = mx.nd.topk(a, k=2, ret_typ="value")
+    assert_almost_equal(vals, -np.sort(-a_np)[:, :2])
+
+
+def test_astype_copy_context():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("float64")
+    assert b.dtype == np.float64
+    c = a.copy()
+    c[:] = 5
+    assert (a.asnumpy() == 1).all()
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.device_type == "cpu"
+    a.wait_to_read()
+    mx.nd.waitall()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.npz")
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.arange(0, 4)
+    mx.nd.save(fname, [a, b])
+    loaded = mx.nd.load(fname)
+    assert len(loaded) == 2
+    assert_almost_equal(loaded[0], a.asnumpy())
+    assert_almost_equal(loaded[1], b.asnumpy())
+    mx.nd.save(fname, {"w": a, "b": b})
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert_almost_equal(loaded["w"], a.asnumpy())
+
+
+def test_where_clip():
+    cond = mx.nd.array([1, 0, 1])
+    x = mx.nd.array([1, 2, 3])
+    y = mx.nd.array([-1, -2, -3])
+    assert_almost_equal(mx.nd.where(cond, x, y), np.array([1, -2, 3], np.float32))
+    assert_almost_equal(x.clip(1.5, 2.5), np.array([1.5, 2, 2.5], np.float32))
+
+
+def test_unary_math():
+    a_np = np.random.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    a = mx.nd.array(a_np)
+    for op, ref in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                    ("square", np.square), ("sin", np.sin), ("cos", np.cos),
+                    ("tanh", np.tanh), ("sign", np.sign), ("floor", np.floor),
+                    ("ceil", np.ceil), ("log1p", np.log1p)]:
+        assert_almost_equal(getattr(mx.nd, op)(a), ref(a_np), rtol=1e-4,
+                            names=(op, op + "_np"))
+    assert_almost_equal(mx.nd.relu(mx.nd.array([-1, 2])), np.array([0, 2], np.float32))
+    assert_almost_equal(mx.nd.sigmoid(mx.nd.zeros((2,))), np.full(2, 0.5, np.float32))
+
+
+def test_iter_len_scalar():
+    a = mx.nd.array([[1, 2], [3, 4], [5, 6]])
+    assert len(a) == 3
+    rows = list(a)
+    assert len(rows) == 3
+    assert_almost_equal(rows[1], np.array([3, 4], np.float32))
+    s = mx.nd.array([42.0])
+    assert s.asscalar() == 42.0
+    assert float(s) == 42.0
+    assert int(s) == 42
+    assert bool(mx.nd.array([1.0]))
+
+
+def test_sparse_basics():
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+    csr = mx.nd.sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.todense(), dense)
+    assert list(csr.indptr.asnumpy()) == [0, 1, 3, 3]
+    rs = mx.nd.sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert list(rs.indices.asnumpy()) == [0, 1]
+    back = mx.nd.sparse.cast_storage(rs, "default")
+    assert back.stype == "default"
+    assert_almost_equal(back, dense)
+    kept = rs.retain(mx.nd.array([0], dtype="int64"))
+    expect = dense.copy()
+    expect[1] = 0
+    assert_almost_equal(kept.todense(), expect)

@@ -1,0 +1,151 @@
+"""Symbol + Executor tests (modeled on reference test_symbol.py /
+test_executor.py / test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"), name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (16, 100)
+    assert shapes["fc1_bias"] == (16,)
+    assert shapes["fc2_weight"] == (10, 16)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv")
+    bn = mx.sym.BatchNorm(conv, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 10, 10))
+    shapes = dict(zip(bn.list_arguments(), arg_shapes))
+    assert shapes["conv_weight"] == (8, 3, 3, 3)
+    assert shapes["bn_gamma"] == (8,)
+    assert out_shapes[0] == (2, 8, 8, 8)
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_symbol_arith_and_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = 2 * a + b ** 2 - 1
+    out = c.eval(a=mx.nd.array([1.0, 2.0]), b=mx.nd.array([3.0, 4.0]))
+    assert_almost_equal(out[0], np.array([10.0, 19.0], np.float32))
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    _, out_shapes, _ = net2.infer_shape(data=(8, 50))
+    assert out_shapes == [(8, 10)]
+
+
+def test_group_and_slicing():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    g = mx.sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(4, 20), softmax_label=(4,))
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    x = np.random.uniform(size=(4, 20)).astype(np.float32)
+    y = np.array([1, 3, 5, 7], np.float32)
+    exe.forward(is_train=True, data=x, softmax_label=y)
+    out = exe.outputs[0].asnumpy()
+    assert out.shape == (4, 10)
+    assert_almost_equal(out.sum(1), np.ones(4), rtol=1e-4)
+    exe.backward()
+    gw = exe.grad_dict["fc2_weight"].asnumpy()
+    assert np.abs(gw).sum() > 0
+
+
+def test_grad_req_add_and_null():
+    x_np = np.random.uniform(size=(3, 4)).astype(np.float32)
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.broadcast_mul(data, w)
+    exe = out.bind(mx.cpu(), {"data": mx.nd.array(x_np), "w": mx.nd.ones((3, 4))},
+                   args_grad={"w": mx.nd.zeros((3, 4))},
+                   grad_req={"data": "null", "w": "add"})
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((3, 4)))
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((3, 4)))
+    assert_almost_equal(exe.grad_dict["w"], 2 * x_np, rtol=1e-5)
+    assert exe.grad_dict.get("data") is None
+
+
+def test_executor_bn_aux_update():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    exe = bn.simple_bind(mx.cpu(), data=(8, 4))
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.normal(3.0, 2.0, (8, 4)).astype(np.float32)
+    exe.forward(is_train=True, data=x)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mm, 0.5 * x.mean(0), rtol=1e-3)
+    # eval-mode forward must not touch aux
+    exe.forward(is_train=False, data=x)
+    assert_almost_equal(exe.aux_dict["bn_moving_mean"], mm)
+
+
+def test_shared_exec_reshape():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(8, 20), softmax_label=(8,))
+    exe2 = exe.reshape(data=(4, 20), softmax_label=(4,))
+    assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+    assert exe2.arg_dict["data"].shape == (4, 20)
+
+
+def test_monitor_callback():
+    data = mx.sym.var("data")
+    out = mx.sym.relu(data, name="act")
+    exe = out.bind(mx.cpu(), {"data": mx.nd.array([-1.0, 2.0])})
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward()
+    assert any("act" in n for n in seen)
+
+
+def test_attr_scope_and_var_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.var("a")
+        b = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr_dict()["fc"]["ctx_group"] == "dev1"
+    v = mx.sym.var("w", shape=(3, 3), lr_mult=2.0)
+    assert v.attr("__lr_mult__") == "2.0"
