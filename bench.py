@@ -1,0 +1,51 @@
+"""Headline benchmark: ResNet-50 inference throughput, batch 32.
+
+Matches the reference's benchmark_score.py configuration
+(`/root/reference/example/image-classification/README.md:147-156`:
+ResNet-50, batch 32, 1 chip — reference scores 109 img/s on a K80).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMG_S = 109.0  # K80 ResNet-50 batch-32 inference (BASELINE.md)
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = 32
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+
+    x = mx.nd.random.uniform(shape=(batch, 3, 224, 224), ctx=ctx)
+    net(x).asnumpy()  # compile + warm cache
+
+    # time a fixed iteration budget, syncing only at the end (the engine is
+    # async-dispatch; per-call sync would measure host latency, not device
+    # throughput — same reason benchmark_score.py uses wait_to_read once)
+    iters = 20
+    t0 = time.time()
+    out = None
+    for _ in range(iters):
+        out = net(x)
+    out.asnumpy()
+    dt = time.time() - t0
+    img_s = batch * iters / dt
+
+    print(json.dumps({
+        "metric": "resnet50_infer_imgs_per_sec_bs32",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
