@@ -140,14 +140,17 @@ class CTCLoss(Loss):
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
         if self._layout == "NTC":
-            pred = F.swapaxes(pred, 0, 1)
+            pred = F.swapaxes(pred, dim1=0, dim2=1)
         if self._batch_axis == 1:
-            label = F.swapaxes(label, 0, 1)
+            label = F.swapaxes(label, dim1=0, dim2=1)
         extra = []
         kwargs = {"blank_label": "last"}
         if pred_lengths is not None:
             extra.append(pred_lengths)
             kwargs["use_data_lengths"] = True
+        if label_lengths is not None:
+            extra.append(label_lengths)
+            kwargs["use_label_lengths"] = True
         loss = F.CTCLoss(pred, label, *extra, **kwargs)
         return _apply_weighting(F, loss, self._weight, sample_weight)
 

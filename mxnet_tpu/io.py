@@ -145,15 +145,21 @@ class NDArrayIter(DataIter):
     def hard_reset(self):
         if self.shuffle:
             np.random.shuffle(self.idx)
+        self._cache_data = None
+        self._cache_label = None
         self.cursor = -self.batch_size
 
     def reset(self):
         if self.shuffle:
             np.random.shuffle(self.idx)
-        if self.last_batch_handle == "roll_over" and \
-                self.num_data - self.batch_size < self.cursor < self.num_data:
-            self.cursor = self.cursor - self.num_data - self.batch_size
+        if self.last_batch_handle == "roll_over" and self._cache_data is not None:
+            # leftover tail of the previous epoch leads the new one: shift
+            # the cursor so the first batch takes batch_size - k new samples
+            k = self._cache_data[0].shape[0]
+            self.cursor = -self.batch_size - k
         else:
+            self._cache_data = None
+            self._cache_label = None
             self.cursor = -self.batch_size
 
     def iter_next(self):
@@ -165,14 +171,26 @@ class NDArrayIter(DataIter):
             raise StopIteration
         data = self.getdata()
         label = self.getlabel()
+        if self.cursor >= 0:
+            # the roll-over cache is only consumed by the epoch's first batch
+            self._cache_data = None
+            self._cache_label = None
+        if self.last_batch_handle == "roll_over" and \
+                data and data[0].shape[0] < self.batch_size:
+            # incomplete tail: hold it for the next epoch instead of emitting
+            self._cache_data = data
+            self._cache_label = label
+            raise StopIteration
         return DataBatch(data=data, label=label, pad=self.getpad(), index=None)
 
-    def _getdata(self, data_source):
+    def _getdata(self, data_source, cache=None):
         end = min(self.cursor + self.batch_size, self.num_data)
         s = slice(max(self.cursor, 0), end)
         out = []
-        for _, arr in data_source:
+        for i, (_, arr) in enumerate(data_source):
             sel = arr[self.idx[s]]
+            if cache is not None and self.cursor < 0:
+                sel = np.concatenate([cache[i].asnumpy(), sel], axis=0)
             if sel.shape[0] < self.batch_size:
                 if self.last_batch_handle == "pad":
                     need = self.batch_size - sel.shape[0]
@@ -182,10 +200,10 @@ class NDArrayIter(DataIter):
         return out
 
     def getdata(self):
-        return self._getdata(self.data)
+        return self._getdata(self.data, self._cache_data)
 
     def getlabel(self):
-        return self._getdata(self.label)
+        return self._getdata(self.label, self._cache_label)
 
     def getpad(self):
         if self.last_batch_handle == "pad" and \
@@ -280,6 +298,7 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = iters[0].batch_size
+        self._depth = depth
         self._queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = None
@@ -323,7 +342,7 @@ class PrefetchingIter(DataIter):
             pass
         self._thread.join(timeout=5)
         self.iters[0].reset()
-        self._queue = _queue.Queue(maxsize=2)
+        self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
     def iter_next(self):

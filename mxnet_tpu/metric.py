@@ -199,6 +199,12 @@ class F1(EvalMetric):
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    @staticmethod
+    def _f1(tp, fp, fn):
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
+
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
@@ -208,16 +214,23 @@ class F1(EvalMetric):
                 pred_np = _np.argmax(pred_np, axis=1)
             pred_np = pred_np.astype("int32").reshape(-1)
             label_np = label_np.reshape(-1)
-            self.tp += ((pred_np == 1) & (label_np == 1)).sum()
-            self.fp += ((pred_np == 1) & (label_np == 0)).sum()
-            self.fn += ((pred_np == 0) & (label_np == 1)).sum()
+            tp = ((pred_np == 1) & (label_np == 1)).sum()
+            fp = ((pred_np == 1) & (label_np == 0)).sum()
+            fn = ((pred_np == 0) & (label_np == 1)).sum()
+            # 'macro' averages the per-update F1; 'micro' pools the counts
+            # (reference metric.py F1.update_binary_stats semantics)
+            self.tp += tp
+            self.fp += fp
+            self.fn += fn
+            self.sum_metric += self._f1(tp, fp, fn)
             self.num_inst += 1
 
     def get(self):
-        prec = self.tp / max(self.tp + self.fp, 1e-12)
-        rec = self.tp / max(self.tp + self.fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return (self.name, f1 if self.num_inst > 0 else float("nan"))
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        if self.average == "macro":
+            return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, self._f1(self.tp, self.fp, self.fn))
 
 
 @register

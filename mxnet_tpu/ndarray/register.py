@@ -26,6 +26,17 @@ _POS_PARAMS = {
     "reverse": ("axis",),
     "smooth_l1": ("scalar",),
     "diag": ("k",),
+    "swapaxes": ("dim1", "dim2"), "SwapAxis": ("dim1", "dim2"),
+    "slice_axis": ("axis", "begin", "end"),
+    "pick": ("axis",),
+    "take": ("axis",),
+    "reshape": ("shape",), "Reshape": ("shape",),
+    "transpose": ("axes",),
+    "squeeze": ("axis",),
+    "stack": ("axis",),
+    "softmax": ("axis",), "log_softmax": ("axis",),
+    "broadcast_axis": ("axis", "size"),
+    "argmax": ("axis",), "argmin": ("axis",),
     "_plus_scalar": ("scalar",), "_minus_scalar": ("scalar",),
     "_mul_scalar": ("scalar",), "_div_scalar": ("scalar",),
     "_power_scalar": ("scalar",),

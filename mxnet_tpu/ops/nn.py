@@ -472,14 +472,20 @@ def _ctc_loss(params, data, label, *lens):
     lab_valid = (lab != pad_val) if blank_first else (lab >= 0)
     lab_len = jnp.sum(lab_valid.astype(jnp.int32), axis=1)
     ext_len = 2 * lab_len + 1
-    if lens:
-        data_len = lens[0].astype(jnp.int32) if params.get("use_data_lengths") else jnp.full((B,), T, jnp.int32)
-    else:
-        data_len = jnp.full((B,), T, jnp.int32)
+    # optional length inputs, in reference order: data_lengths, label_lengths
+    lens = list(lens)
+    data_len = jnp.full((B,), T, jnp.int32)
+    if params.get("use_data_lengths") and lens:
+        data_len = lens.pop(0).astype(jnp.int32)
+    if params.get("use_label_lengths") and lens:
+        lab_len = lens.pop(0).astype(jnp.int32)
+        ext_len = 2 * lab_len + 1
     NEG = -1e10
     S = 2 * L + 1
-    pos = jnp.arange(S)[None, :]
-    alpha0 = jnp.where(pos < 2, 0.0, NEG)  # can start at blank or first label
+    # before frame 0 only the path start (position 0, shifted into 0/1 by
+    # the first recurrence step) carries mass; the first scan iteration then
+    # yields alpha_0 = emission at positions 0 and 1 only
+    alpha0 = jnp.full((B, S), NEG, jnp.float32).at[:, 0].set(0.0)
     gather = jax.vmap(lambda lp, e: lp[e])  # (B,C),(B,S)->(B,S)
 
     def step(alpha, lp_t):
@@ -499,6 +505,9 @@ def _ctc_loss(params, data, label, *lens):
     final = jnp.take_along_axis(alphas, t_idx[None, :, None], axis=0)[0]  # (B, S)
     a_end = jnp.take_along_axis(final, (ext_len - 1)[:, None], axis=1)[:, 0]
     a_end2 = jnp.take_along_axis(final, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    # an empty label (ext_len == 1) has only the all-blank path; don't
+    # double-count the single end position
+    a_end2 = jnp.where(ext_len >= 2, a_end2, NEG)
     loss = -jnp.logaddexp(a_end, a_end2)
     return (loss.astype(data.dtype),)
 

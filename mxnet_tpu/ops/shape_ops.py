@@ -385,18 +385,17 @@ def _topk(params, x):
         vals = -vals
     else:
         vals, idxs = lax.top_k(xm, k)
+    dt = dtype_np(params.get("dtype", "float32"))
+    if rt == "mask":
+        # one_hot over the moved (k) axis BEFORE restoring the data axis
+        oh = jax.nn.one_hot(idxs, xm.shape[-1], dtype=x.dtype).sum(-2)
+        return (jnp.moveaxis(oh, -1, ax),)
     vals = jnp.moveaxis(vals, -1, ax)
     idxs = jnp.moveaxis(idxs, -1, ax)
-    dt = dtype_np(params.get("dtype", "float32"))
     if rt == "value":
         return (vals,)
     if rt == "both":
         return (vals, idxs.astype(dt))
-    if rt == "mask":
-        mask = jnp.zeros(xm.shape, x.dtype)
-        mask = mask.at[..., :].set(0)
-        oh = jax.nn.one_hot(idxs, xm.shape[-1], dtype=x.dtype).sum(-2)
-        return (jnp.moveaxis(oh, -1, ax),)
     return (idxs.astype(dt),)
 
 

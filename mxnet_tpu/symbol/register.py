@@ -10,15 +10,25 @@ __all__ = ["populate"]
 
 def _make_fn(name):
     def fn(*args, **kwargs):
+        # positional scalar attrs use the same table as the ndarray frontend
+        from ..ndarray.register import _POS_PARAMS
+        pos_params = _POS_PARAMS.get(name, ())
         sym_name = kwargs.pop("name", None)
         inputs = []
+        extra_pos = []
         for a in args:
             if isinstance(a, Symbol):
                 inputs.append(a)
             elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
                 inputs.extend(a)
             else:
-                raise TypeError("%s: positional args must be Symbols" % name)
+                extra_pos.append(a)
+        if extra_pos:
+            if len(extra_pos) > len(pos_params):
+                raise TypeError("%s: too many positional attribute args (%d)"
+                                % (name, len(extra_pos)))
+            for pname, pval in zip(pos_params, extra_pos):
+                kwargs.setdefault(pname, pval)
         attrs = {}
         for k, v in kwargs.items():
             if isinstance(v, Symbol):
