@@ -8,6 +8,10 @@ annotations over a `jax.sharding.Mesh` with XLA-inserted collectives.
 from .mesh import MeshContext, get_mesh, data_parallel_mesh, make_mesh
 from . import dist
 from .data_parallel import DataParallelTrainStep, split_and_load_sharded
+from .ring_attention import (ring_attention, ulysses_attention,
+                             local_attention, sequence_sharding)
 
 __all__ = ["MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
-           "dist", "DataParallelTrainStep", "split_and_load_sharded"]
+           "dist", "DataParallelTrainStep", "split_and_load_sharded",
+           "ring_attention", "ulysses_attention", "local_attention",
+           "sequence_sharding"]

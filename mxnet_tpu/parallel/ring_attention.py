@@ -1,0 +1,171 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY.md §2.8 — long sequences
+are handled by bucketing, `python/mxnet/module/bucketing_module.py`). This
+module is the new first-class capability: attention over sequences sharded
+across a mesh axis, with communication riding ICI via XLA collectives.
+
+Two strategies, both exact (bitwise-stable streaming softmax, no
+approximation):
+
+- :func:`ring_attention` — each device holds a sequence block of Q/K/V;
+  K/V blocks rotate around the ring via ``lax.ppermute`` while each device
+  accumulates its queries' attention with the flash-attention streaming
+  rescale (running max ``m``, normalizer ``l``, accumulator ``o``).
+  Communication per step is one K/V block over the nearest ICI neighbour,
+  overlapping with the block matmul — the classic Ring Attention schedule.
+- :func:`ulysses_attention` — two ``all_to_all`` reshuffles: gather full
+  sequence while scattering heads, run dense local attention, reshuffle
+  back. Cheaper collectives when heads %% axis_size == 0 and sequence is
+  moderate.
+
+Layout convention: ``[batch, seq, heads, head_dim]`` sharded as
+``P(None, axis, None, None)`` (sequence axis sharded).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except ImportError:  # older jax: same call, pre-rename kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention",
+           "sequence_sharding"]
+
+_NEG = -1e30
+
+
+def sequence_sharding(mesh, axis="sp"):
+    """NamedSharding placing the sequence dim of [B,T,H,D] on `axis`."""
+    return NamedSharding(mesh, P(None, axis, None, None))
+
+
+def local_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
+    """Dense single-device attention on [B,T,H,D] tensors (the oracle).
+
+    `q_offset`/`k_offset` give the global positions of q[.,0] and k[.,0]
+    so causal masks stay correct on sequence shards.
+    """
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_body(q, k, v, *, axis, causal, scale):
+    """shard_map body: per-device ring attention over sequence shards."""
+    idx = lax.axis_index(axis)
+    n = lax.psum(1, axis)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale_ = (1.0 / d ** 0.5) if scale is None else scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qpos = idx * tq + jnp.arange(tq)
+
+    def step(t, carry):
+        o, l, m, k, v = carry
+        # after t rotations device `idx` holds the block that started on
+        # device (idx - t) mod n
+        src = (idx - t) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale_
+        if causal:
+            kpos = src * tk + jnp.arange(tk)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        return o, l, m_new, k, v
+
+    # accumulate in f32 regardless of input dtype (bf16 inputs on TPU):
+    # the running sum l and accumulator o add n partial results, and
+    # _NEG overflows fp16
+    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    m0 = jnp.full((b, h, tq), _NEG, jnp.float32)
+    o, l, _, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
+    l = jnp.where(l == 0, 1.0, l)  # defensive; l>0 after the diagonal block
+    o = (o / l[..., None]).astype(q.dtype)
+    return jnp.transpose(o, (0, 2, 1, 3))  # [B,Tq,H,D]
+
+
+def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
+    """Exact attention over a sequence-sharded [B,T,H,D] Q/K/V.
+
+    Each of the `axis`-many devices keeps its Q shard resident and streams
+    K/V shards around the ring (`lax.ppermute`), accumulating softmax
+    online. Peak per-device memory is O(T/n); comm volume is the full K/V
+    once around the ring, nearest-neighbour over ICI.
+
+    Works under jit: wraps the body in `shard_map` over `mesh`.
+    """
+    if mesh is None:
+        from .mesh import get_mesh
+        mesh = get_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention needs a mesh (pass mesh= or enter "
+                         "a MeshContext)")
+    spec = P(None, axis, None, None)
+    body = functools.partial(_ring_body, axis=axis, causal=causal, scale=scale)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_body(q, k, v, *, axis, causal, scale):
+    # [B, T/n, H, D] -> [B, T, H/n, D]: scatter heads, gather sequence
+    q = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    o = local_attention(q, k, v, causal=causal, scale=scale)
+    # back: scatter sequence, gather heads
+    return lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):
+    """Ulysses-style sequence parallelism: all_to_all to head-sharded
+    layout, dense local attention, all_to_all back.
+
+    Requires `heads % mesh.shape[axis] == 0`.
+    """
+    if mesh is None:
+        from .mesh import get_mesh
+        mesh = get_mesh()
+    if mesh is None:
+        raise ValueError("ulysses_attention needs a mesh")
+    n = mesh.shape[axis]
+    if q.shape[2] % n != 0:
+        raise ValueError("heads (%d) must divide by sp axis size (%d)"
+                         % (q.shape[2], n))
+    spec = P(None, axis, None, None)
+    body = functools.partial(_ulysses_body, axis=axis, causal=causal,
+                             scale=scale)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)

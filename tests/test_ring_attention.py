@@ -1,0 +1,88 @@
+"""Sequence/context parallelism tests on the 8-device CPU mesh.
+
+The reference has no SP (SURVEY.md §2.8); oracle is dense local attention.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.ring_attention import (
+    ring_attention, ulysses_attention, local_attention, sequence_sharding)
+
+
+def _qkv(b=2, t=32, h=4, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return make_mesh({"sp": 4})
+
+
+def _shard(mesh, *xs):
+    s = sequence_sharding(mesh)
+    return tuple(jax.device_put(x, s) for x in xs)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(sp_mesh, causal):
+    q, k, v = _qkv()
+    want = local_attention(q, k, v, causal=causal)
+    qs, ks, vs = _shard(sp_mesh, q, k, v)
+    got = ring_attention(qs, ks, vs, mesh=sp_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(sp_mesh, causal):
+    q, k, v = _qkv()
+    want = local_attention(q, k, v, causal=causal)
+    qs, ks, vs = _shard(sp_mesh, q, k, v)
+    got = ulysses_attention(qs, ks, vs, mesh=sp_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_under_jit_keeps_sharding(sp_mesh):
+    q, k, v = _qkv()
+    qs, ks, vs = _shard(sp_mesh, q, k, v)
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh=sp_mesh, causal=True)
+
+    out = f(qs, ks, vs)
+    spec = out.sharding.spec
+    assert tuple(spec)[:2] == (None, "sp")
+    want = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads(sp_mesh):
+    q, k, v = _qkv(t=16)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=sp_mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(local_attention(q, k, v, causal=True) ** 2)
+
+    qs, ks, vs = _shard(sp_mesh, q, k, v)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(qs, ks, vs)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(sp_mesh):
+    q, k, v = _qkv(h=3)
+    with pytest.raises(ValueError):
+        ulysses_attention(q, k, v, mesh=sp_mesh)
