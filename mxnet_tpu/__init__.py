@@ -46,6 +46,7 @@ from . import monitor
 from .monitor import Monitor
 from . import test_utils
 from . import parallel
+from . import rtc
 from .attribute import AttrScope
 from .name import NameManager
 

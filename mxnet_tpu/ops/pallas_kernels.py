@@ -1,0 +1,344 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+TPU-native replacement for the reference's handwritten CUDA/cuDNN kernels:
+flash attention stands in for fused attention, and the fused LSTM layer
+kernel replaces cuDNN's fused RNN (`src/operator/cudnn_rnn-inl.h` in the
+reference). On non-TPU backends every kernel runs through the Pallas
+interpreter, so the same code path is testable on CPU.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- flash attention: grid over (batch*heads, q blocks); K/V stay resident in
+  VMEM per (batch, head) and the kernel streams q blocks, accumulating the
+  numerically-stable streaming softmax in f32 registers. Causal mode bounds
+  the inner k-block loop at the diagonal so masked blocks are never
+  computed.
+- fused LSTM: the input projection x@Wx for ALL timesteps is one big MXU
+  matmul outside the kernel; the kernel walks time on the grid with h/c
+  held in VMEM scratch, doing only the recurrent h@Wh matmul per step.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "fused_lstm", "is_tpu"]
+
+_NEG = -1e30
+
+
+def _cast(x, dt):
+    # Mosaic's convert_element_type lowering recurses forever on an
+    # identity cast, so only emit the convert when dtypes differ
+    return x if x.dtype == dt else x.astype(dt)
+
+
+def is_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _interpret():
+    return not is_tpu()
+
+
+# ---------------------------------------------------------------- attention
+
+_LANES = 128
+
+
+def _lanes_bcast(x, n):
+    """Broadcast a lane-replicated (bq, 128) stat to n columns."""
+    if n == _LANES:
+        return x
+    if n < _LANES:
+        return x[:, :n]
+    if n % _LANES:
+        raise NotImplementedError("width %d not a multiple of %d"
+                                  % (n, _LANES))
+    return jnp.tile(x, (1, n // _LANES))
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                      scale, causal, block_q, block_k, seq_k):
+    """Grid (bh, q blocks, k blocks); k innermost. The streaming-softmax
+    stats m/l and the output accumulator live in VMEM scratch (persisted
+    across the k sweep) with lane-replicated (block_q, 128) stats — value
+    carries of big f32 arrays through fori_loop blow Mosaic's register
+    budget."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    scale32 = jnp.float32(scale)
+    neg = jnp.float32(_NEG)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full(m_scr.shape, neg, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        run = qi * block_q + (block_q - 1) >= ki * block_k
+    else:
+        run = True
+
+    @pl.when(run)
+    def _():
+        q = _cast(q_ref[0], jnp.float32)                  # (block_q, d)
+        k = _cast(k_ref[0], jnp.float32)                  # (block_k, d)
+        v = _cast(v_ref[0], jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale32  # (bq, bk)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < seq_k                               # K/V tail padding
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, qpos >= kpos)
+        s = jnp.where(mask, s, neg)
+
+        m_prev = m_scr[:]                                 # (bq, 128)
+        l_prev = l_scr[:]
+        m_curr = jnp.max(s, axis=1)[:, None]              # (bq, 1)
+        m_next = jnp.maximum(m_prev, m_curr)              # (bq, 128)
+        p = jnp.exp(s - _lanes_bcast(m_next, block_k))
+        alpha = jnp.exp(m_prev - m_next)                  # (bq, 128)
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr     # (bq, 128)
+        m_scr[:] = m_next
+        l_scr[:] = l_next
+        l_inv = jnp.where(l_next == jnp.float32(0.0),
+                          jnp.float32(1.0), jnp.float32(1.0) / l_next)
+        d = acc_scr.shape[-1]
+        acc_scr[:] = acc_scr[:] * _lanes_bcast(l_corr * l_inv, d)
+        acc_scr[:] += jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * _lanes_bcast(l_inv, d)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        o_ref[0] = _cast(acc_scr[:], o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    """q,k,v: [BH, T, D] -> [BH, T, D]."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    # pad K/V to a block multiple so every grid block is full-size; the
+    # kpos mask neutralises the padded keys
+    tk_pad = pl.cdiv(tk, block_k) * block_k
+    if tk_pad != tk:
+        pad = [(0, 0), (0, tk_pad - tk), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    grid = (bh, pl.cdiv(tq, block_q), tk_pad // block_k)
+    kern = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=tk)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            # index maps return j*0 instead of a literal 0: the axon AOT
+            # service lowers python-int constants as i64, which Mosaic
+            # cannot legalize in the index-map func.return
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, j * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, i * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, j * 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _dense_attention(q, k, v, scale, causal):
+    """Reference math on [BH, T, D]; used for the backward pass."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    # backward recomputes attention with the dense math (O(T^2) memory in
+    # the bwd only); a pallas bwd kernel is a later optimisation
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=False,
+                    block_q=128, block_k=128):
+    """Fused attention on [B, T, H, D] (same layout as
+    `parallel.ring_attention`). Differentiable; forward is a Pallas kernel,
+    interpret-mode on CPU."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    to_bh = lambda x, t: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+    o = _flash(to_bh(q, tq), to_bh(k, tk), to_bh(v, tk),
+               scale, causal, block_q, block_k)
+    return jnp.transpose(o.reshape(b, h, tq, d), (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------- fused LSTM
+
+def _lstm_kernel(xp_ref, wh_ref, h0_ref, c0_ref, hseq_ref, hn_ref, cn_ref,
+                 h_scr, c_scr, *, hidden):
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    gates = xp_ref[0] + jnp.dot(h, wh_ref[:],
+                                preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gates[:, :hidden])
+    f = jax.nn.sigmoid(gates[:, hidden:2 * hidden])
+    g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+    o = jax.nn.sigmoid(gates[:, 3 * hidden:])
+    c = f * c_scr[:] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[:] = h
+    c_scr[:] = c
+    hseq_ref[0] = h
+
+    @pl.when(t == nt - 1)
+    def _():
+        hn_ref[:] = h
+        cn_ref[:] = c
+
+
+def _lstm_scan_ref(x, h0, c0, wx, wh, b):
+    """lax.scan LSTM with identical math; differentiable reference used for
+    the fused kernel's backward pass."""
+    hid = wh.shape[0]
+    xp = jnp.einsum("tbi,ih->tbh", x, wx,
+                    preferred_element_type=jnp.float32) + b
+
+    def step(carry, xpt):
+        h, c = carry
+        gates = xpt + jnp.dot(h, wh, preferred_element_type=jnp.float32)
+        i = jax.nn.sigmoid(gates[:, :hid])
+        f = jax.nn.sigmoid(gates[:, hid:2 * hid])
+        g = jnp.tanh(gates[:, 2 * hid:3 * hid])
+        o = jax.nn.sigmoid(gates[:, 3 * hid:])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hn, cn), hseq = jax.lax.scan(step, (h0, c0), xp)
+    return hseq, hn, cn
+
+
+@jax.custom_vjp
+def fused_lstm(x, h0, c0, wx, wh, b):
+    """Single-layer LSTM over a full sequence (cuDNN-RNN analog).
+
+    x: [T, B, I]; h0/c0: [B, H]; wx: [I, 4H]; wh: [H, 4H]; b: [4H].
+    Gate order i, f, g, o. Returns (h_seq [T,B,H], h_n, c_n).
+
+    The x projection for all T timesteps runs as one MXU matmul; the Pallas
+    kernel walks time on the grid keeping h/c in VMEM scratch, so HBM
+    traffic per step is just the x-projection block and the h output.
+    """
+    t, bs, _ = x.shape
+    hidden = wh.shape[0]
+    xp = (jnp.einsum("tbi,ih->tbh", x, wx,
+                     preferred_element_type=jnp.float32)
+          + b.astype(jnp.float32))
+    kern = functools.partial(_lstm_kernel, hidden=hidden)
+    hseq, hn, cn = pl.pallas_call(
+        kern,
+        grid=(t,),
+        in_specs=[
+            # i*0 instead of literal 0: see _flash_fwd index-map note
+            pl.BlockSpec((1, bs, 4 * hidden), lambda i: (i, i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, 4 * hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bs, hidden), lambda i: (i, i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, bs, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((bs, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((bs, hidden), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bs, hidden), jnp.float32),
+            pltpu.VMEM((bs, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, wh.astype(jnp.float32), h0.astype(jnp.float32),
+      c0.astype(jnp.float32))
+    return hseq.astype(x.dtype), hn.astype(x.dtype), cn.astype(x.dtype)
+
+
+def _lstm_vjp_fwd(x, h0, c0, wx, wh, b):
+    return fused_lstm(x, h0, c0, wx, wh, b), (x, h0, c0, wx, wh, b)
+
+
+def _lstm_vjp_bwd(res, g):
+    # backward recomputes the sequence with the scan reference (same math,
+    # differentiable); a fused pallas backward is a later optimisation.
+    # Compute in f32 (the kernel's accumulation dtype; also f64 inputs are
+    # legal at the NDArray layer but not on the MXU) and cast grads back.
+    res32 = tuple(_cast(r, jnp.float32) for r in res)
+    g32 = tuple(_cast(t, jnp.float32) for t in g)
+    _, vjp = jax.vjp(_lstm_scan_ref, *res32)
+    return tuple(_cast(gr, r.dtype) for gr, r in zip(vjp(g32), res))
+
+
+fused_lstm.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)

@@ -1,0 +1,120 @@
+"""Runtime kernel compilation — the TPU analog of `mx.rtc`.
+
+The reference compiles CUDA C source at runtime with NVRTC and launches it
+through the engine (`include/mxnet/rtc.h:39`, `src/common/rtc.cc:35-69`,
+`python/mxnet/rtc.py`). On TPU the user-supplied kernel language is
+**Pallas**: `PallasModule` takes Python source defining Pallas kernel
+functions (`pl`/`pltpu`/`jax`/`jnp` are pre-imported into the module
+namespace), and `Kernel.launch` wraps them in `pl.pallas_call`, jit-caches
+the result, and returns framework NDArrays.
+
+API shape mirrors `mx.rtc.CudaModule(source, options, exports)` /
+`get_kernel(name, signature)` / `kernel.launch(args, ctx, grid_dims,
+block_dims)`; grid maps to the Pallas grid, block dims have no TPU meaning
+and are ignored (the Mosaic compiler tiles onto the MXU/VPU itself).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .ndarray import ndarray as _nd
+from .ops.pallas_kernels import is_tpu
+
+__all__ = ["PallasModule", "Kernel"]
+
+
+class PallasModule:
+    """Compile Pallas kernel source at runtime.
+
+    `source` is Python code defining one or more kernel functions of
+    refs, e.g.::
+
+        mod = mx.rtc.PallasModule('''
+        def axpy(x_ref, y_ref, out_ref):
+            out_ref[:] = 2.0 * x_ref[:] + y_ref[:]
+        ''')
+        k = mod.get_kernel("axpy")
+        out = k.launch((x, y), out_shapes=[((n,), 'float32')])
+    """
+
+    def __init__(self, source, options=(), exports=()):
+        if callable(source):  # also accept an already-defined function
+            self._namespace = {source.__name__: source}
+        else:
+            self._namespace = {"pl": pl, "pltpu": pltpu, "jax": jax,
+                               "jnp": jnp}
+            exec(compile(source, "<rtc.PallasModule>", "exec"),
+                 self._namespace)
+        self._exports = tuple(exports)
+
+    def get_kernel(self, name, signature=None):
+        """Look up a kernel function by name. `signature` is accepted for
+        CudaModule API compatibility and unused (Pallas kernels are typed
+        by their launch out_shapes). If the module was created with
+        `exports`, only exported names are retrievable (CudaModule
+        semantics)."""
+        if self._exports and name not in self._exports:
+            raise ValueError("kernel %r not in exports %s"
+                             % (name, list(self._exports)))
+        fn = self._namespace.get(name)
+        if fn is None or not callable(fn):
+            raise ValueError("no kernel %r in module (have: %s)"
+                             % (name, [k for k, v in self._namespace.items()
+                                       if callable(v) and not k.startswith("_")]))
+        return Kernel(fn, name)
+
+
+class Kernel:
+    """A launchable Pallas kernel (analog of `mx.rtc.CudaKernel`)."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self._name = name
+        self._cache = {}
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0, out_shapes=None, in_specs=None, out_specs=None,
+               scratch_shapes=None):
+        """Launch on `args` (NDArrays or jax arrays).
+
+        `out_shapes`: list of (shape, dtype) for each kernel output.
+        `grid_dims`: Pallas grid tuple (optional). `block_dims`/
+        `shared_mem` are ignored on TPU. `in_specs`/`out_specs`/
+        `scratch_shapes` pass through to `pl.pallas_call` for advanced
+        kernels.
+        """
+        if out_shapes is None:
+            raise ValueError("launch needs out_shapes=[(shape, dtype), ...]")
+        jargs = tuple(a._data if isinstance(a, _nd.NDArray) else jnp.asarray(a)
+                      for a in args)
+        multi = len(out_shapes) > 1
+        if grid_dims is not None:
+            grid_dims = tuple(grid_dims)
+        key = (tuple((tuple(s), str(d)) for s, d in out_shapes),
+               grid_dims, tuple(a.shape for a in jargs),
+               tuple(str(a.dtype) for a in jargs),
+               repr(in_specs), repr(out_specs), repr(scratch_shapes))
+        call = self._cache.get(key)
+        if call is None:
+            out_shape = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                         for s, d in out_shapes]
+            kwargs = dict(out_shape=out_shape if multi else out_shape[0],
+                          interpret=not is_tpu())
+            if grid_dims is not None:
+                kwargs["grid"] = tuple(grid_dims)
+            if in_specs is not None:
+                kwargs["in_specs"] = in_specs
+            if out_specs is not None:
+                kwargs["out_specs"] = out_specs
+            if scratch_shapes is not None:
+                kwargs["scratch_shapes"] = scratch_shapes
+            call = jax.jit(pl.pallas_call(self._fn, **kwargs))
+            self._cache[key] = call
+        outs = call(*jargs)
+        if not multi:
+            outs = (outs,)
+        res = [_nd.NDArray(o) for o in outs]
+        return res if multi else res[0]
