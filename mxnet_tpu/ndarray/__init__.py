@@ -22,3 +22,4 @@ def zeros_like(a, **kw):
 def ones_like(a, **kw):
     from ..ops.invoke import invoke
     return invoke("ones_like", [a], kw)
+from . import contrib  # noqa: E402,F401

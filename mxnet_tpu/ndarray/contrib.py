@@ -1,0 +1,16 @@
+"""`mx.nd.contrib` namespace (reference python/mxnet/ndarray/contrib.py):
+every registered `_contrib_Foo` op is exposed here as `contrib.Foo`."""
+from ..ops.registry import _OPS
+from .register import _make_fn
+
+
+def _populate_contrib(namespace, make_fn):
+    for name, op in list(_OPS.items()):
+        if not op.visible or not name.startswith("_contrib_"):
+            continue
+        short = name[len("_contrib_"):]
+        if short not in namespace:
+            namespace[short] = make_fn(name)
+
+
+_populate_contrib(globals(), _make_fn)

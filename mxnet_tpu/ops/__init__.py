@@ -17,5 +17,6 @@ from . import optimizer_ops # noqa: F401
 from . import init_ops      # noqa: F401
 from . import linalg_ops    # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import detection     # noqa: F401
 
 __all__ = ["register", "get_op", "has_op", "list_ops", "Operator", "invoke"]

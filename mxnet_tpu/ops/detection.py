@@ -1,0 +1,718 @@
+"""Detection / spatial operators: SSD MultiBox suite, RCNN Proposal,
+spatial transformer family, correlation, deformable conv, and the fork's
+research ops (LSoftmax, weighted L1, multi-logistic, point-cloud ops).
+
+Parity targets (behavior, not implementation):
+- MultiBox*: reference `src/operator/contrib/multibox_prior.cc`,
+  `multibox_target.cc`, `multibox_detection.cc`
+- Proposal: `src/operator/contrib/multi_proposal.cc` / `proposal.cu`
+- SpatialTransformer/GridGenerator/BilinearSampler:
+  `src/operator/spatial_transformer-inl.h`, `grid_generator-inl.h`,
+  `bilinear_sampler-inl.h`
+- Correlation: `src/operator/correlation-inl.h`
+- DeformableConvolution: `src/operator/contrib/deformable_convolution-inl.h`
+- LSoftmax (fork): `src/operator/lsoftmax.cu:80-95`
+- weighted_l1 / multi_logistic (fork): `src/operator/weighted_l1-inl.h`,
+  `multi_logistic-inl.h`
+- BallQuery / FarthestPointSampling (fork): `src/operator/contrib/
+  ball_query-inl.h:36-66`, `farthest_point_sampling.cc`
+
+All are pure-JAX (static shapes, lax control flow) so they jit, grad, and
+shard like every other op. Sequential argmax loops (bipartite matching,
+NMS, FPS) use `lax.fori_loop` with masks instead of data-dependent breaks.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .contrib_ops import box_iou_xyxy
+
+
+def _tuple_param(params, key, default):
+    v = params.get(key, default)
+    if isinstance(v, str):
+        v = v.strip("()[] ")
+        v = tuple(float(t) for t in v.split(",") if t.strip())
+    elif isinstance(v, (int, float)):
+        v = (float(v),)
+    return tuple(float(t) for t in v)
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox suite
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def _multibox_prior(params, data):
+    """Anchor generation. data (B,C,H,W) -> (1, H*W*A, 4) corner boxes in
+    [0,1] coords; A = num_sizes - 1 + num_ratios, ordered sizes-then-ratios
+    per location (caffe-SSD layout, multibox_prior.cc:43-70)."""
+    sizes = _tuple_param(params, "sizes", (1.0,))
+    ratios = _tuple_param(params, "ratios", (1.0,))
+    steps = _tuple_param(params, "steps", (-1.0, -1.0))
+    offsets = _tuple_param(params, "offsets", (0.5, 0.5))
+    clip = params.get("clip", False)
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+
+    half = []
+    for s in sizes:                       # ratio 1, every size
+        half.append((s * h / w / 2.0, s / 2.0))
+    for r in ratios[1:]:                  # size[0], remaining ratios
+        sr = math.sqrt(r)
+        half.append((sizes[0] * h / w * sr / 2.0, sizes[0] / sr / 2.0))
+    hw = jnp.asarray([p[0] for p in half], jnp.float32)  # half widths
+    hh = jnp.asarray([p[1] for p in half], jnp.float32)  # half heights
+
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
+    cyg = cyg[..., None]                                 # (H, W, 1)
+    cxg = cxg[..., None]
+    boxes = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1)
+    boxes = boxes.reshape(1, h * w * len(half), 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return (boxes.astype(data.dtype),)
+
+
+def _encode_box(anchor, gt, variances):
+    """(gx-ax)/aw/vx encoding (multibox_target.cc:31-55)."""
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) * 0.5
+    ay = (anchor[..., 1] + anchor[..., 3]) * 0.5
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gy = (gt[..., 1] + gt[..., 3]) * 0.5
+    vx, vy, vw, vh = variances
+    eps = 1e-12
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, eps) / vx,
+        (gy - ay) / jnp.maximum(ah, eps) / vy,
+        jnp.log(jnp.maximum(gw / jnp.maximum(aw, eps), eps)) / vw,
+        jnp.log(jnp.maximum(gh / jnp.maximum(ah, eps), eps)) / vh,
+    ], axis=-1)
+
+
+def _decode_box(anchor, pred, variances, clip):
+    """Inverse transform (multibox_detection.cc TransformLocations)."""
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) * 0.5
+    ay = (anchor[..., 1] + anchor[..., 3]) * 0.5
+    vx, vy, vw, vh = variances
+    ox = pred[..., 0] * vx * aw + ax
+    oy = pred[..., 1] * vy * ah + ay
+    ow = jnp.exp(pred[..., 2] * vw) * aw * 0.5
+    oh = jnp.exp(pred[..., 3] * vh) * ah * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          num_outputs=3)
+def _multibox_target(params, anchor, label, cls_pred):
+    """SSD training targets (multibox_target.cc MultiBoxTargetForward).
+
+    anchor (1,A,4), label (B,G,>=5) rows [cls,x1,y1,x2,y2,...] padded with
+    -1, cls_pred (B,C,A). Returns loc_target (B,A*4), loc_mask (B,A*4),
+    cls_target (B,A) with classes shifted +1 (0 = background,
+    ignore_label for don't-care anchors).
+    """
+    overlap_threshold = params.get("overlap_threshold", 0.5)
+    ignore_label = params.get("ignore_label", -1.0)
+    neg_ratio = params.get("negative_mining_ratio", -1.0)
+    neg_thresh = params.get("negative_mining_thresh", 0.5)
+    min_neg = params.get("minimum_negative_samples", 0)
+    variances = _tuple_param(params, "variances", (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    G = label.shape[1]
+
+    def one_batch(lab, cp):
+        # valid gts: prefix until the first cls == -1 (reference breaks)
+        valid_gt = jnp.cumprod(lab[:, 0] != -1.0).astype(bool)   # (G,)
+        n_valid = jnp.sum(valid_gt)
+        ious = box_iou_xyxy(anchors, lab[:, 1:5])                 # (A, G)
+        ious = jnp.where(valid_gt[None, :], ious, -1.0)
+
+        # --- bipartite matching: G rounds of global argmax -------------
+        def bmatch(_, carry):
+            aflag, agt, aiou, gdone = carry
+            m = jnp.where(aflag[:, None] | gdone[None, :], -1.0, ious)
+            flat = jnp.argmax(m)
+            bi = (flat // G).astype(jnp.int32)
+            bg = (flat % G).astype(jnp.int32)
+            ok = m[bi, bg] > 1e-6
+            aflag = aflag.at[bi].set(jnp.where(ok, True, aflag[bi]))
+            agt = agt.at[bi].set(jnp.where(ok, bg, agt[bi]))
+            aiou = aiou.at[bi].set(jnp.where(ok, m[bi, bg], aiou[bi]))
+            gdone = gdone.at[bg].set(jnp.where(ok, True, gdone[bg]))
+            return aflag, agt, aiou, gdone
+
+        aflag = jnp.zeros((A,), bool)          # matched-positive flags
+        agt = jnp.full((A,), -1, jnp.int32)    # matched gt index
+        aiou = jnp.full((A,), -1.0)            # matched iou
+        gdone = ~valid_gt                      # invalid gts count as done
+        aflag, agt, aiou, gdone = lax.fori_loop(
+            0, G, bmatch, (aflag, agt, aiou, gdone))
+
+        # --- threshold matching for remaining anchors ------------------
+        best_gt = jnp.argmax(ious, axis=1)
+        best_iou = jnp.max(ious, axis=1)
+        use_thr = (~aflag) & (best_iou > overlap_threshold) & (
+            overlap_threshold > 0)
+        agt = jnp.where(aflag, agt, best_gt)
+        aiou = jnp.where(aflag, aiou, best_iou)
+        aflag = aflag | use_thr
+        num_pos = jnp.sum(aflag)
+
+        # --- negatives --------------------------------------------------
+        if neg_ratio > 0:
+            # hard negative mining: lowest background prob first
+            prob_bg = jax.nn.softmax(cp, axis=0)[0]               # (A,)
+            cand = (~aflag) & (aiou < neg_thresh)
+            num_neg = jnp.clip((num_pos * neg_ratio).astype(jnp.int32),
+                               int(min_neg), A)
+            num_neg = jnp.minimum(num_neg, A - num_pos)
+            order = jnp.argsort(jnp.where(cand, prob_bg, jnp.inf))
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                jnp.arange(A, dtype=jnp.int32))
+            neg = cand & (rank < num_neg)
+        else:
+            neg = ~aflag
+
+        has_gt = n_valid > 0
+        aflag = aflag & has_gt
+        neg = jnp.where(has_gt, neg, jnp.ones((A,), bool))
+
+        gt_cls = lab[jnp.clip(agt, 0, G - 1), 0]
+        cls_t = jnp.where(aflag, gt_cls + 1.0,
+                          jnp.where(neg, 0.0, ignore_label))
+        gt_box = lab[jnp.clip(agt, 0, G - 1), 1:5]
+        loc_t = jnp.where(aflag[:, None],
+                          _encode_box(anchors, gt_box, variances), 0.0)
+        loc_m = jnp.broadcast_to(aflag[:, None], (A, 4)).astype(loc_t.dtype)
+        return (loc_t.reshape(-1), loc_m.reshape(-1), cls_t)
+
+    loc_t, loc_m, cls_t = jax.vmap(one_batch)(label, cls_pred)
+    dt = anchor.dtype
+    return (loc_t.astype(dt), loc_m.astype(dt), cls_t.astype(dt))
+
+
+def _greedy_nms(boxes, scores, valid, class_id, thresh, topk, force):
+    """Greedy NMS; returns keep mask (same order as inputs). Scores drive
+    priority; suppression only among same class unless force."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    b = boxes[order]
+    ious = box_iou_xyxy(b, b)
+    if not force and class_id is not None:
+        cid = class_id[order]
+        ious = jnp.where(cid[:, None] == cid[None, :], ious, 0.0)
+    keep0 = valid[order]
+    if topk > 0:
+        keep0 = keep0 & (jnp.arange(N) < topk)
+
+    def body(i, keep):
+        sup = (ious[i] > thresh) & (jnp.arange(N) > i) & keep[i]
+        return keep & ~sup
+
+    keep_sorted = lax.fori_loop(0, N, body, keep0)
+    keep = jnp.zeros((N,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
+def _multibox_detection(params, cls_prob, loc_pred, anchor):
+    """SSD decode + NMS (multibox_detection.cc MultiBoxDetectionForward).
+
+    cls_prob (B,C,A), loc_pred (B,A*4), anchor (1,A,4) ->
+    out (B,A,6) rows [id, score, x1,y1,x2,y2]; invalid rows are -1.
+    Class ids are shifted back (-1 removes background).
+    """
+    clip = params.get("clip", True)
+    threshold = params.get("threshold", 0.01)
+    bg_id = params.get("background_id", 0)
+    nms_threshold = params.get("nms_threshold", 0.5)
+    force = params.get("force_suppress", False)
+    variances = _tuple_param(params, "variances", (0.1, 0.1, 0.2, 0.2))
+    nms_topk = params.get("nms_topk", -1)
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+
+    def one_batch(cp, lp):
+        # best non-background class per anchor
+        scores = jnp.where(
+            (jnp.arange(cp.shape[0]) == bg_id)[:, None], -jnp.inf, cp)
+        cid = jnp.argmax(scores, axis=0)                  # (A,)
+        score = jnp.max(scores, axis=0)
+        valid = score >= threshold
+        boxes = _decode_box(anchors, lp.reshape(A, 4), variances, clip)
+        out_id = jnp.where(valid, (cid - 1).astype(cp.dtype), -1.0)
+        if 0 < nms_threshold <= 1:
+            keep = _greedy_nms(boxes, score, valid, cid, nms_threshold,
+                               nms_topk, force)
+            out_id = jnp.where(valid & ~keep, -1.0, out_id)
+        rows = jnp.concatenate(
+            [out_id[:, None], score[:, None], boxes], axis=1)
+        rows = jnp.where(valid[:, None], rows, -1.0)
+        # reference emits rows in descending score order
+        # (multibox_detection.cc:137-151); invalid rows (-1) sort last
+        perm = jnp.argsort(-jnp.where(valid, score, -jnp.inf), stable=True)
+        return rows[perm]
+
+    out = jax.vmap(one_batch)(cls_prob, loc_pred)
+    return (out.astype(cls_prob.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# RCNN Proposal
+# ---------------------------------------------------------------------------
+
+def _rcnn_base_anchors(base_size, scales, ratios):
+    """RCNN-style base anchors centered on a base_size cell."""
+    px, py = (base_size - 1) * 0.5, (base_size - 1) * 0.5
+    out = []
+    for r in ratios:
+        size = base_size * base_size / r
+        ws = round(math.sqrt(size))
+        hs = round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            out.append([px - 0.5 * (w - 1), py - 0.5 * (h - 1),
+                        px + 0.5 * (w - 1), py + 0.5 * (h - 1)])
+    return np.asarray(out, np.float32)
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "_contrib_MultiProposal"))
+def _proposal(params, cls_prob, bbox_pred, im_info):
+    """RPN proposal generation (contrib/multi_proposal.cc behavior).
+
+    cls_prob (B,2A,H,W), bbox_pred (B,4A,H,W), im_info (B,3)=[h,w,scale]
+    -> rois (B*post_nms_top_n, 5) [batch_idx, x1,y1,x2,y2] (+scores when
+    output_score)."""
+    scales = _tuple_param(params, "scales", (4.0, 8.0, 16.0, 32.0))
+    ratios = _tuple_param(params, "ratios", (0.5, 1.0, 2.0))
+    stride = int(params.get("feature_stride", 16))
+    pre_top = int(params.get("rpn_pre_nms_top_n", 6000))
+    post_top = int(params.get("rpn_post_nms_top_n", 300))
+    nms_thresh = params.get("threshold", 0.7)
+    min_size = params.get("rpn_min_size", 16)
+    output_score = params.get("output_score", False)
+
+    B, _, H, W = cls_prob.shape
+    base = _rcnn_base_anchors(stride, scales, ratios)     # (A,4)
+    A = base.shape[0]
+    sy = jnp.arange(H, dtype=jnp.float32) * stride
+    sx = jnp.arange(W, dtype=jnp.float32) * stride
+    shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), axis=-1)  # H,W,2
+    shift = jnp.tile(shift, (1, 1, 2))                    # (H,W,4) x,y,x,y
+    anchors = (shift[:, :, None, :] + jnp.asarray(base)[None, None]
+               ).reshape(-1, 4)                           # (H*W*A, 4)
+
+    def one_batch(cp, bp, info):
+        im_h, im_w = info[0], info[1]
+        # fg scores: channels [A:2A]; layout (A,H,W) -> (H,W,A) flat
+        fg = jnp.transpose(cp[A:], (1, 2, 0)).reshape(-1)
+        deltas = jnp.transpose(bp.reshape(A, 4, H, W), (2, 3, 0, 1)
+                               ).reshape(-1, 4)
+        # rcnn decode: dx,dy are center shifts relative to w/h
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+        ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        w = jnp.exp(deltas[:, 2]) * aw
+        h = jnp.exp(deltas[:, 3]) * ah
+        x1 = jnp.clip(cx - 0.5 * (w - 1.0), 0, im_w - 1.0)
+        y1 = jnp.clip(cy - 0.5 * (h - 1.0), 0, im_h - 1.0)
+        x2 = jnp.clip(cx + 0.5 * (w - 1.0), 0, im_w - 1.0)
+        y2 = jnp.clip(cy + 0.5 * (h - 1.0), 0, im_h - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        ms = min_size * info[2]
+        valid = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+        # gather the static-size top pre_top candidates FIRST so NMS works
+        # on a (pre_top, pre_top) IoU matrix, not the full anchor grid
+        # (reference sorts then NMSes rpn_pre_nms_top_n boxes)
+        n = boxes.shape[0]
+        k = min(pre_top, n)
+        scr, sel = lax.top_k(jnp.where(valid, fg, -jnp.inf), k)
+        bsel = boxes[sel]
+        vsel = jnp.isfinite(scr)
+        keep = _greedy_nms(bsel, scr, vsel, None, nms_thresh, -1, True)
+        # select top post_top kept by score
+        order = jnp.argsort(-jnp.where(keep, scr, -jnp.inf))
+        if k < post_top:
+            order = jnp.pad(order, (0, post_top - k))
+            keep = jnp.pad(keep, (0, post_top - k))
+        sel2 = order[:post_top]
+        ok = keep[sel2]
+        rois = jnp.where(ok[:, None], bsel[sel2 % k], 0.0)
+        out_scr = jnp.where(ok, scr[sel2 % k], 0.0)
+        return rois, out_scr
+
+    rois, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(B, dtype=cls_prob.dtype), post_top)
+    rois = jnp.concatenate([bidx[:, None], rois.reshape(-1, 4)], axis=1)
+    if output_score:
+        return (rois.astype(cls_prob.dtype),
+                scores.reshape(-1, 1).astype(cls_prob.dtype))
+    return (rois.astype(cls_prob.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Spatial transformer family
+# ---------------------------------------------------------------------------
+
+def _affine_grid(theta, h, w):
+    """theta (B,6) -> sampling grid (B,2,H,W) in [-1,1] (x, y rows)."""
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    src = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)   # (3, H*W)
+    t = theta.reshape(-1, 2, 3)
+    out = jnp.einsum("bij,jn->bin", t, src)                  # (B,2,H*W)
+    return out.reshape(-1, 2, h, w)
+
+
+def _bilinear_sample(data, grid):
+    """data (B,C,H,W), grid (B,2,H',W') x/y in [-1,1]; zero outside
+    (reference bilinear_sampler-inl.h)."""
+    B, C, H, W = data.shape
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0                  # (B,H',W')
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(y, x):
+        yi = jnp.clip(y, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(x, 0, W - 1).astype(jnp.int32)
+        v = jax.vmap(lambda d, yy, xx: d[:, yy, xx])(data, yi, xi)  # B,C,H',W'
+        inb = ((y >= 0) & (y <= H - 1) & (x >= 0) & (x <= W - 1))
+        return v * inb[:, None].astype(data.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(params, data, grid):
+    return (_bilinear_sample(data, grid).astype(data.dtype),)
+
+
+@register("GridGenerator")
+def _grid_generator(params, data):
+    """transform_type 'affine': data (B,6) theta -> grid (B,2,H,W) over
+    target_shape. 'warp': data (B,2,H,W) optical flow -> normalized grid
+    (reference grid_generator-inl.h)."""
+    ttype = params.get("transform_type", "affine")
+    if ttype == "affine":
+        h, w = (int(v) for v in _tuple_param(params, "target_shape", (0, 0)))
+        if h <= 0 or w <= 0:
+            raise ValueError("GridGenerator(transform_type='affine') "
+                             "requires target_shape=(H, W)")
+        return (_affine_grid(data, h, w).astype(data.dtype),)
+    # warp: flow (B,2,H,W), output grid = (base + flow) normalized
+    B, _, h, w = data.shape
+    gy, gx = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                          jnp.arange(w, dtype=data.dtype), indexing="ij")
+    x = (gx[None] + data[:, 0]) * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+    y = (gy[None] + data[:, 1]) * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+    return (jnp.stack([x, y], axis=1).astype(data.dtype),)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(params, data, loc):
+    """Affine spatial transformer with bilinear sampling
+    (reference spatial_transformer-inl.h)."""
+    h, w = (int(v) for v in _tuple_param(params, "target_shape", (0, 0)))
+    if h == 0 or w == 0:
+        h, w = data.shape[2], data.shape[3]
+    grid = _affine_grid(loc, h, w)
+    return (_bilinear_sample(data, grid).astype(data.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet)
+# ---------------------------------------------------------------------------
+
+@register("Correlation")
+def _correlation(params, data1, data2):
+    """FlowNet correlation (reference correlation-inl.h). Output channel
+    per displacement (2*max_d/stride2+1)^2, averaged over channels and the
+    kernel window."""
+    ksize = int(params.get("kernel_size", 1))
+    max_d = int(params.get("max_displacement", 1))
+    stride1 = int(params.get("stride1", 1))
+    stride2 = int(params.get("stride2", 1))
+    pad = int(params.get("pad_size", 0))
+    mult = params.get("is_multiply", True)
+    B, C, H, W = data1.shape
+    kr = (ksize - 1) // 2
+    d = max_d // stride2  # displacement steps per direction
+    nd = 2 * d + 1
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = H + 2 * pad, W + 2 * pad
+    border = max_d + kr
+    oh = int(math.ceil((ph - border * 2) / float(stride1)))
+    ow = int(math.ceil((pw - border * 2) / float(stride1)))
+    ys = border + jnp.arange(oh) * stride1
+    xs = border + jnp.arange(ow) * stride1
+
+    def corr_at(dy, dx):
+        acc = 0.0
+        for ky in range(-kr, kr + 1):
+            for kx in range(-kr, kr + 1):
+                a = p1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                b = p2[:, :, ys[:, None] + dy + ky, xs[None, :] + dx + kx]
+                acc = acc + (a * b if mult else jnp.abs(a - b))
+        return jnp.sum(acc, axis=1) / (ksize * ksize * C)
+
+    outs = [corr_at((i // nd - d) * stride2, (i % nd - d) * stride2)
+            for i in range(nd * nd)]
+    return (jnp.stack(outs, axis=1).astype(data1.dtype),)
+
+
+@register("Correlation1D")
+def _correlation1d(params, data1, data2):
+    """Fork op: horizontal-only correlation (stereo) —
+    src/operator/correlation1D.cc."""
+    ksize = int(params.get("kernel_size", 1))
+    max_d = int(params.get("max_displacement", 1))
+    stride1 = int(params.get("stride1", 1))
+    stride2 = int(params.get("stride2", 1))
+    pad = int(params.get("pad_size", 0))
+    mult = params.get("is_multiply", True)
+    single_side = int(params.get("single_side", 0))
+    B, C, H, W = data1.shape
+    kr = (ksize - 1) // 2
+    d = max_d // stride2
+    if single_side == 0:
+        disps = [i * stride2 for i in range(-d, d + 1)]
+    elif single_side < 0:
+        disps = [i * stride2 for i in range(-d, 1)]
+    else:
+        disps = [i * stride2 for i in range(0, d + 1)]
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    pw = W + 2 * pad
+    border = max_d + kr
+    ow = int(math.ceil((pw - border * 2) / float(stride1)))
+    xs = border + jnp.arange(ow) * stride1
+
+    def corr_at(dx):
+        acc = 0.0
+        for kx in range(-kr, kr + 1):
+            a = p1[:, :, :, xs + kx]
+            b = p2[:, :, :, xs + dx + kx]
+            acc = acc + (a * b if mult else jnp.abs(a - b))
+        return jnp.sum(acc, axis=1) / (ksize * C)
+
+    return (jnp.stack([corr_at(dx) for dx in disps], axis=1
+                      ).astype(data1.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def _deformable_conv(params, data, offset, weight, *bias):
+    """Deformable conv v1 (contrib/deformable_convolution-inl.h):
+    bilinear-sample each kernel tap at its learned offset, then contract
+    with the weights — an im2col-of-gathers followed by one MXU matmul."""
+    kh, kw = (int(v) for v in _tuple_param(params, "kernel", (3, 3)))
+    sh, sw = (int(v) for v in _tuple_param(params, "stride", (1, 1)))
+    ph, pw = (int(v) for v in _tuple_param(params, "pad", (0, 0)))
+    dh, dw = (int(v) for v in _tuple_param(params, "dilate", (1, 1)))
+    ngroup = int(params.get("num_deformable_group", 1))
+    B, C, H, W = data.shape
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    # offset: (B, 2*ngroup*kh*kw, oh, ow), layout [g, k, (y,x)]
+    off = offset.reshape(B, ngroup, kh * kw, 2, oh, ow)
+
+    base_y = (jnp.arange(oh) * sh - ph)[:, None]          # (oh,1)
+    base_x = (jnp.arange(ow) * sw - pw)[None, :]          # (1,ow)
+
+    cols = []
+    cpg = C // ngroup
+    for g in range(ngroup):
+        dg = data[:, g * cpg:(g + 1) * cpg]               # (B,cpg,H,W)
+        taps = []
+        for i, (ky, kx) in enumerate(
+                (a, b) for a in range(kh) for b in range(kw)):
+            py = base_y + ky * dh + off[:, g, i, 0]       # (B,oh,ow)
+            px = base_x + kx * dw + off[:, g, i, 1]
+            gx = px * 2.0 / jnp.maximum(W - 1, 1) - 1.0
+            gy = py * 2.0 / jnp.maximum(H - 1, 1) - 1.0
+            taps.append(_bilinear_sample(dg, jnp.stack([gx, gy], axis=1)))
+        cols.append(jnp.stack(taps, axis=2))              # (B,cpg,K,oh,ow)
+    col = jnp.concatenate(cols, axis=1)                   # (B,C,K,oh,ow)
+    # grouped contraction (reference num_group): weight is (O, C/ng, kh, kw)
+    ng = int(params.get("num_group", 1))
+    O = weight.shape[0]
+    colg = col.reshape(B, ng, (C // ng) * kh * kw, oh, ow)
+    wg = weight.reshape(ng, O // ng, (C // ng) * kh * kw)
+    out = jnp.einsum("gof,bgfhw->bgohw", wg, colg).reshape(B, O, oh, ow)
+    if bias and not params.get("no_bias", False):
+        out = out + bias[0][None, :, None, None]
+    return (out.astype(data.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# Fork research ops
+# ---------------------------------------------------------------------------
+
+@register("LSoftmax", need_train_flag=True)
+def _lsoftmax(params, data, weight, label):
+    """Large-margin softmax (fork src/operator/lsoftmax.cu:80-95).
+    out = x@w.T with the target logit replaced by
+    (((-1)^k cos(m*theta) - 2k) * |x||w|  + beta*f) / (1+beta) in train."""
+    margin = int(params.get("margin", 2))
+    beta = params.get("beta", 1.0)
+    out = jnp.dot(data, weight.T)
+    if not params.get("_is_train", params.get("is_train", False)):
+        return (out,)
+    n = data.shape[0]
+    x_norm = jnp.linalg.norm(data, axis=1)
+    w_norm = jnp.linalg.norm(weight, axis=1)
+    yi = label.astype(jnp.int32)
+    f = out[jnp.arange(n), yi]
+    denom = jnp.maximum(x_norm * w_norm[yi], 1e-12)
+    cos_t = jnp.clip(f / denom, -1.0, 1.0)
+    # k such that cos(k*pi/m) >= cos_t >= cos((k+1)*pi/m)
+    k_table = jnp.cos(jnp.arange(1, margin + 1) * jnp.pi / margin)
+    k = jnp.sum(cos_t[:, None] < k_table[None, :], axis=1)
+    # cos(m t) = sum_p (-1)^p C(m,2p) cos^(m-2p) sin^(2p)
+    sin2 = 1.0 - cos_t * cos_t
+    cos_mt = jnp.zeros_like(cos_t)
+    for p in range(margin // 2 + 1):
+        c = math.comb(margin, 2 * p) * ((-1) ** p)
+        cos_mt = cos_mt + c * cos_t ** (margin - 2 * p) * sin2 ** p
+    f_new = (((-1.0) ** k) * cos_mt - 2.0 * k) * denom
+    f_out = (f_new + beta * f) / (1.0 + beta)
+    out = out.at[jnp.arange(n), yi].set(f_out.astype(out.dtype))
+    return (out,)
+
+
+def _make_fork_loss():
+    @jax.custom_vjp
+    def _wl1(data, label, gscale):
+        return data
+
+    def _wl1_fwd(data, label, gscale):
+        return data, (data, label, gscale)
+
+    def _wl1_bwd(res, g):
+        data, label, gscale = res
+        grad = gscale * jnp.sign(data - label) * (label > 0)
+        return grad.astype(data.dtype), None, None
+
+    _wl1.defvjp(_wl1_fwd, _wl1_bwd)
+
+    @register("weighted_l1", aliases=("WeightedL1",))
+    def _weighted_l1(params, data, label):
+        """Fork src/operator/weighted_l1-inl.h: identity forward; backward
+        grad_scale * sign(out - label) masked to label > 0."""
+        return (_wl1(data, label, params.get("grad_scale", 1.0)),)
+
+    @jax.custom_vjp
+    def _ml(data, label, gscale):
+        return jax.nn.sigmoid(data)
+
+    def _ml_fwd(data, label, gscale):
+        out = jax.nn.sigmoid(data)
+        return out, (out, label, gscale)
+
+    def _ml_bwd(res, g):
+        out, label, gscale = res
+        return (gscale * (out - label)).astype(out.dtype), None, None
+
+    _ml.defvjp(_ml_fwd, _ml_bwd)
+
+    @register("MultiLogistic", aliases=("multi_logistic",))
+    def _multi_logistic(params, data, label):
+        """Fork src/operator/multi_logistic-inl.h: sigmoid forward,
+        backward (p - y) per element (multi-label logistic loss)."""
+        return (_ml(data, label, params.get("grad_scale", 1.0)),)
+
+
+_make_fork_loss()
+
+
+@register("_contrib_BallQuery", aliases=("BallQuery",))
+def _ball_query(params, xyz, query):
+    """Point-cloud ball query (fork contrib/ball_query-inl.h:36-66):
+    for each query point, indices of up to nsample points within radius;
+    slots past the found count repeat the FIRST found index."""
+    radius = params["radius"]
+    nsample = int(params["nsample"])
+    r2 = radius * radius
+    N = xyz.shape[1]
+
+    def per_query(pts, q):
+        d2 = jnp.sum((pts - q[None, :]) ** 2, axis=1)     # (N,)
+        hit = d2 < r2
+        rank = jnp.cumsum(hit) - 1                        # rank among hits
+        first = jnp.argmax(hit)                           # first hit index
+        has = jnp.any(hit)
+        # slots default to the first hit; scatter each hit into its rank
+        # (ranks >= nsample fall off the end and are dropped)
+        src = jnp.where(hit & (rank < nsample), rank, nsample)
+        idx0 = jnp.full((nsample,), jnp.where(has, first, 0), jnp.int32)
+        return idx0.at[src].set(jnp.arange(N, dtype=jnp.int32), mode="drop")
+
+    out = jax.vmap(lambda pts, qs: jax.vmap(
+        lambda q: per_query(pts, q))(qs))(xyz, query)
+    return (out.astype(jnp.int32),)
+
+
+@register("_contrib_FarthestPointSampling",
+          aliases=("FarthestPointSampling",))
+def _farthest_point_sampling(params, xyz):
+    """Iterative farthest point sampling (fork contrib/
+    farthest_point_sampling.cc): start at point 0, repeatedly take the
+    point with max distance to the selected set."""
+    npoints = int(params["npoints"])
+    N = xyz.shape[1]
+
+    def one(pts):
+        def body(i, carry):
+            idx, mind = carry
+            last = pts[idx[i - 1]]
+            d = jnp.sum((pts - last[None, :]) ** 2, axis=1)
+            mind = jnp.minimum(mind, d)
+            idx = idx.at[i].set(jnp.argmax(mind).astype(jnp.int32))
+            return idx, mind
+
+        idx0 = jnp.zeros((npoints,), jnp.int32)
+        mind0 = jnp.full((N,), jnp.inf)
+        idx, _ = lax.fori_loop(1, npoints, body, (idx0, mind0))
+        return idx
+
+    return (jax.vmap(one)(xyz).astype(jnp.int32),)

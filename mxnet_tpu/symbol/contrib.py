@@ -1,0 +1,5 @@
+"""`mx.sym.contrib` namespace (reference python/mxnet/symbol/contrib.py)."""
+from ..ndarray.contrib import _populate_contrib
+from .register import _make_fn
+
+_populate_contrib(globals(), _make_fn)

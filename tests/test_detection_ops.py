@@ -1,0 +1,267 @@
+"""Detection / spatial / fork op tests against NumPy oracles
+(mirrors reference tests/python/unittest/test_operator.py style)."""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get_op
+
+
+def run_op(name, params, *inputs):
+    outs = get_op(name).fcompute(params, *(jnp.asarray(i) for i in inputs))
+    return [np.asarray(o) for o in outs]
+
+
+def test_multibox_prior_matches_reference_layout():
+    data = np.zeros((1, 3, 2, 3), np.float32)  # H=2, W=3
+    sizes, ratios = (0.5, 0.25), (1.0, 2.0)
+    (out,) = run_op("_contrib_MultiBoxPrior",
+                    {"sizes": sizes, "ratios": ratios}, data)
+    h, w = 2, 3
+    na = len(sizes) - 1 + len(ratios)
+    assert out.shape == (1, h * w * na, 4)
+    # oracle: loop exactly as multibox_prior.cc:43-70
+    want = []
+    for r in range(h):
+        cy = (r + 0.5) / h
+        for c in range(w):
+            cx = (c + 0.5) / w
+            for s in sizes:
+                ww, hh = s * h / w / 2, s / 2
+                want.append([cx - ww, cy - hh, cx + ww, cy + hh])
+            for rt in ratios[1:]:
+                sr = math.sqrt(rt)
+                ww, hh = sizes[0] * h / w * sr / 2, sizes[0] / sr / 2
+                want.append([cx - ww, cy - hh, cx + ww, cy + hh])
+    np.testing.assert_allclose(out[0], np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_target_simple_match():
+    # two anchors, one gt that clearly matches anchor 0
+    anchors = np.asarray([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                         np.float32)
+    label = np.asarray([[[1.0, 0.05, 0.05, 0.45, 0.45],
+                         [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    loc_t, loc_m, cls_t = run_op("_contrib_MultiBoxTarget", {},
+                                 anchors, label, cls_pred)
+    assert cls_t.shape == (1, 2)
+    assert cls_t[0, 0] == 2.0        # class 1 shifted +1
+    assert cls_t[0, 1] == 0.0        # background
+    assert loc_m[0, :4].sum() == 4.0 and loc_m[0, 4:].sum() == 0.0
+    # encoding oracle for anchor 0
+    ax, ay, aw, ah = 0.25, 0.25, 0.5, 0.5
+    gx, gy, gw, gh = 0.25, 0.25, 0.4, 0.4
+    want = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+            math.log(gw / aw) / 0.2, math.log(gh / ah) / 0.2]
+    np.testing.assert_allclose(loc_t[0, :4], want, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_no_gt_all_background():
+    anchors = np.random.RandomState(0).rand(1, 5, 4).astype(np.float32)
+    label = -np.ones((2, 3, 5), np.float32)
+    cls_pred = np.zeros((2, 4, 5), np.float32)
+    loc_t, loc_m, cls_t = run_op("_contrib_MultiBoxTarget", {},
+                                 anchors, label, cls_pred)
+    assert (cls_t == 0).all() and (loc_m == 0).all() and (loc_t == 0).all()
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.asarray([[[0.1, 0.1, 0.3, 0.3],
+                           [0.11, 0.11, 0.31, 0.31],
+                           [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # zero loc_pred => boxes == anchors
+    loc_pred = np.zeros((1, 12), np.float32)
+    # cls_prob (B, C=2, A): background + 1 class
+    cls_prob = np.asarray([[[0.1, 0.2, 0.3],
+                            [0.9, 0.8, 0.7]]], np.float32)
+    (out,) = run_op("_contrib_MultiBoxDetection",
+                    {"nms_threshold": 0.5}, cls_prob, loc_pred, anchors)
+    assert out.shape == (1, 3, 6)
+    ids = out[0, :, 0]
+    # anchor 0 (score .9) kept, anchor 1 suppressed (iou~.8), anchor 2 kept
+    assert ids[0] == 0.0 and ids[1] == -1.0 and ids[2] == 0.0
+    np.testing.assert_allclose(out[0, 0, 2:], [0.1, 0.1, 0.3, 0.3],
+                               atol=1e-5)
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(0)
+    B, A, H, W = 1, 12, 4, 4  # 4 scales x 3 ratios
+    cls_prob = rng.rand(B, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.randn(B, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.asarray([[64.0, 64.0, 1.0]], np.float32)
+    (rois,) = run_op("_contrib_Proposal",
+                     {"rpn_post_nms_top_n": 8, "rpn_pre_nms_top_n": 50,
+                      "feature_stride": 16}, cls_prob, bbox_pred, im_info)
+    assert rois.shape == (8, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1:] >= 0).all() and (rois[:, [1, 3]] <= 64).all()
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(1)
+    data = rng.randn(2, 3, 5, 7).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 7),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].repeat(2, 0).astype(np.float32)
+    (out,) = run_op("BilinearSampler", {}, data, grid)
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_identity_affine():
+    rng = np.random.RandomState(2)
+    data = rng.randn(1, 2, 6, 6).astype(np.float32)
+    theta = np.asarray([[1, 0, 0, 0, 1, 0]], np.float32)
+    (out,) = run_op("SpatialTransformer", {"target_shape": (6, 6)},
+                    data, theta)
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow_is_identity_grid():
+    flow = np.zeros((1, 2, 4, 5), np.float32)
+    (grid,) = run_op("GridGenerator", {"transform_type": "warp"}, flow)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    np.testing.assert_allclose(grid[0, 0], xs, atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1], ys, atol=1e-6)
+
+
+def test_correlation_zero_displacement_is_mean_product():
+    rng = np.random.RandomState(3)
+    a = rng.randn(1, 4, 6, 6).astype(np.float32)
+    (out,) = run_op("Correlation",
+                    {"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                     "stride2": 1, "pad_size": 1}, a, a)
+    assert out.shape[1] == 9  # 3x3 displacements
+    # center channel (index 4) at interior = mean over C of a*a
+    want = (a * a).mean(axis=1)
+    np.testing.assert_allclose(out[0, 4], want[0], rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(4)
+    data = rng.randn(1, 3, 7, 7).astype(np.float32)
+    weight = rng.randn(5, 3, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    (out,) = run_op("_contrib_DeformableConvolution",
+                    {"kernel": (3, 3), "num_filter": 5, "no_bias": True},
+                    data, offset, weight)
+    # oracle: plain valid conv
+    import jax
+    want = jax.lax.conv_general_dilated(
+        jnp.asarray(data), jnp.asarray(weight), (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-3, atol=1e-4)
+
+
+def test_lsoftmax_eval_is_linear_train_reduces_target_logit():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(6, 8).astype(np.float32)
+    y = np.asarray([0, 1, 2, 3], np.float32)
+    (out_eval,) = run_op("LSoftmax", {"num_hidden": 6}, x, w, y)
+    np.testing.assert_allclose(out_eval, x @ w.T, rtol=1e-5, atol=1e-5)
+    (out_tr,) = run_op("LSoftmax",
+                       {"num_hidden": 6, "is_train": True, "margin": 2,
+                        "beta": 0.0}, x, w, y)
+    # margin penalises the target logit (never increases it)
+    for i, yi in enumerate(y.astype(int)):
+        assert out_tr[i, yi] <= out_eval[i, yi] + 1e-5
+        # non-target logits untouched
+        mask = np.ones(6, bool); mask[yi] = False
+        np.testing.assert_allclose(out_tr[i, mask], out_eval[i, mask],
+                                   rtol=1e-5, atol=1e-5)
+    # oracle for sample 0: psi(theta) = 2cos^2 - 1 (m=2), k from table
+    xn = np.linalg.norm(x[0]); wn = np.linalg.norm(w[0])
+    cos_t = (x[0] @ w[0]) / (xn * wn)
+    k = 1 if cos_t < math.cos(math.pi / 2) else 0
+    cos_mt = 2 * cos_t ** 2 - 1
+    want = ((-1) ** k * cos_mt - 2 * k) * xn * wn
+    np.testing.assert_allclose(out_tr[0, 0], want, rtol=1e-4, atol=1e-4)
+
+
+def test_weighted_l1_and_multi_logistic_grads():
+    import jax
+    rng = np.random.RandomState(6)
+    data = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+    label = jnp.asarray((rng.rand(3, 4) > 0.5).astype(np.float32))
+    f = get_op("weighted_l1").fcompute
+    out = f({}, data, label)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(data))
+    g = jax.grad(lambda d: jnp.sum(f({}, d, label)[0]))(data)
+    want = np.sign(np.asarray(data) - np.asarray(label)) * (
+        np.asarray(label) > 0)
+    np.testing.assert_allclose(np.asarray(g), want)
+
+    f2 = get_op("multi_logistic").fcompute
+    out2 = f2({}, data, label)[0]
+    np.testing.assert_allclose(np.asarray(out2),
+                               1 / (1 + np.exp(-np.asarray(data))),
+                               rtol=1e-5)
+    g2 = jax.grad(lambda d: jnp.sum(f2({}, d, label)[0]))(data)
+    np.testing.assert_allclose(np.asarray(g2),
+                               np.asarray(out2) - np.asarray(label),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ball_query_matches_reference_loop():
+    rng = np.random.RandomState(7)
+    xyz = rng.rand(2, 20, 3).astype(np.float32)
+    query = rng.rand(2, 4, 3).astype(np.float32)
+    r, ns = 0.4, 5
+    (idx,) = run_op("_contrib_BallQuery", {"radius": r, "nsample": ns},
+                    xyz, query)
+    # oracle: reference ball_query-inl.h loop
+    for b in range(2):
+        for m in range(4):
+            want = np.zeros(ns, np.int64)
+            cnt = 0
+            for k in range(20):
+                if ((xyz[b, k] - query[b, m]) ** 2).sum() < r * r:
+                    if cnt == 0:
+                        want[:] = k
+                    want[cnt] = k
+                    cnt += 1
+                    if cnt >= ns:
+                        break
+            np.testing.assert_array_equal(idx[b, m], want)
+
+
+def test_farthest_point_sampling():
+    # 4 corners + center: FPS from corner 0 picks far corners first
+    pts = np.asarray([[[0, 0, 0], [10, 10, 0], [10, 0, 0], [0, 10, 0],
+                       [5, 5, 0]]], np.float32)
+    (idx,) = run_op("_contrib_FarthestPointSampling", {"npoints": 4}, pts)
+    assert idx[0, 0] == 0 and idx[0, 1] == 1
+    assert set(idx[0, 2:].tolist()) == {2, 3}
+
+
+def test_lsoftmax_train_flag_via_invoke():
+    # the margin must engage through the real nd path under train_mode
+    rng = np.random.RandomState(8)
+    x = mx.nd.array(rng.randn(3, 6).astype(np.float32))
+    w = mx.nd.array(rng.randn(4, 6).astype(np.float32))
+    y = mx.nd.array(np.asarray([0, 1, 2], np.float32))
+    out_eval = mx.nd.LSoftmax(x, w, y, num_hidden=4).asnumpy()
+    with mx.autograd.train_mode():
+        out_tr = mx.nd.LSoftmax(x, w, y, num_hidden=4, beta=0.0).asnumpy()
+    assert not np.allclose(out_eval, out_tr)
+
+
+def test_deformable_conv_grouped():
+    rng = np.random.RandomState(9)
+    data = rng.randn(1, 4, 5, 5).astype(np.float32)
+    weight = rng.randn(6, 2, 3, 3).astype(np.float32)  # num_group=2
+    offset = np.zeros((1, 18, 3, 3), np.float32)
+    (out,) = run_op("_contrib_DeformableConvolution",
+                    {"kernel": (3, 3), "num_filter": 6, "num_group": 2,
+                     "no_bias": True}, data, offset, weight)
+    import jax
+    want = jax.lax.conv_general_dilated(
+        jnp.asarray(data), jnp.asarray(weight), (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=2)
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-3, atol=1e-4)
