@@ -1,2 +1,3 @@
 """Gluon contrib (reference `python/mxnet/gluon/contrib/`): growing set."""
 from . import rnn
+from . import nn  # noqa: F401

@@ -1,0 +1,36 @@
+"""Contrib nn blocks (reference python/mxnet/gluon/contrib/nn/basic_layers.py):
+HybridConcurrent (parallel branches, concatenated outputs) and Identity."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["HybridConcurrent", "Identity"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Run children on the same input and concat outputs along `axis`."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        raise RuntimeError("HybridConcurrent dispatches via _forward_impl")
+
+    def _forward_impl(self, x):
+        from ... import ndarray as F
+        outs = [c._forward_impl(x) if isinstance(c, HybridBlock) else c(x)
+                for c in self._children.values()]
+        return F.Concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+    def _forward_impl(self, x):
+        return x

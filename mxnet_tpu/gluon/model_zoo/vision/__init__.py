@@ -1,19 +1,18 @@
 """Model zoo vision entry (reference
-`python/mxnet/gluon/model_zoo/vision/__init__.py` get_model registry).
-
-densenet/inception arrive in a later tranche; the registry reports exactly
-what is implemented.
-"""
+`python/mxnet/gluon/model_zoo/vision/__init__.py` get_model registry)."""
 from .resnet import *
 from .alexnet import *
 from .vgg import *
 from .mobilenet import *
 from .squeezenet import *
+from .densenet import *
+from .inception import *
 
 from .resnet import get_resnet
 from .vgg import get_vgg
 from .squeezenet import get_squeezenet
 from .mobilenet import get_mobilenet, get_mobilenet_v2
+from .densenet import get_densenet
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
@@ -31,6 +30,9 @@ _models = {
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
     "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

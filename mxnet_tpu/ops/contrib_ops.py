@@ -70,13 +70,39 @@ def _box_iou(params, lhs, rhs):
     return (box_iou_xyxy(a, b),)
 
 
+def greedy_nms_keep(boxes, scores, valid, class_id, thresh, topk, force):
+    """Greedy NMS keep-mask (original input order) over (N,4) boxes.
+
+    Score-sorted fori_loop suppression with a full IoU matrix — static
+    shapes, TPU-friendly (reference contrib/bounding_box-inl.h). Shared by
+    box_nms, MultiBoxDetection, and Proposal. `topk > 0` keeps only the
+    topk highest-scoring candidates. Suppression is restricted to matching
+    `class_id` unless `force`.
+    """
+    N = boxes.shape[0]
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    b = boxes[order]
+    ious = box_iou_xyxy(b, b)
+    if not force and class_id is not None:
+        cid = class_id[order]
+        ious = jnp.where(cid[:, None] == cid[None, :], ious, 0.0)
+    keep0 = valid[order]
+    if topk > 0:
+        keep0 = keep0 & (jnp.arange(N) < topk)
+
+    def body(i, keep):
+        sup = (ious[i] > thresh) & (jnp.arange(N) > i) & keep[i]
+        return keep & ~sup
+
+    keep_sorted = lax.fori_loop(0, N, body, keep0)
+    return jnp.zeros((N,), bool).at[order].set(keep_sorted)
+
+
 @register("_contrib_box_nms", aliases=("box_nms",))
 def _box_nms(params, data):
-    """Greedy NMS over (B, N, K>=6) [id, score, x1,y1,x2,y2,...] boxes.
-
-    Implemented as a fori_loop over a score-sorted copy — static shapes,
-    TPU-friendly (reference contrib/bounding_box-inl.h).
-    """
+    """Greedy NMS over (B, N, K>=6) [id, score, x1,y1,x2,y2,...] boxes;
+    output rows sorted by descending score, suppressed rows -1
+    (reference contrib/bounding_box-inl.h)."""
     thresh = params.get("overlap_thresh", 0.5)
     vthresh = params.get("valid_thresh", 0.0)
     topk = params.get("topk", -1)
@@ -89,27 +115,16 @@ def _box_nms(params, data):
     if x.ndim == 2:
         x = x[None]
         squeeze = True
-    B, N, K = x.shape
-    scores = x[..., score_i]
-    order = jnp.argsort(-scores, axis=1)
-    xs = jnp.take_along_axis(x, order[..., None], axis=1)
-    boxes = xs[..., coord:coord + 4]
-    ious = box_iou_xyxy(boxes, boxes)
-    valid = xs[..., score_i] > vthresh
-    if topk > 0:
-        valid = valid & (jnp.arange(N)[None, :] < topk)
-    if not force and id_i >= 0:
-        same = xs[..., id_i][:, :, None] == xs[..., id_i][:, None, :]
-        ious = jnp.where(same, ious, 0.0)
 
-    def body(i, keep):
-        iou_i = lax.dynamic_index_in_dim(ious, i, axis=1, keepdims=False)
-        keep_i = lax.dynamic_index_in_dim(keep, i, axis=1, keepdims=True)
-        sup = (iou_i > thresh) & (jnp.arange(N)[None, :] > i) & keep_i
-        return keep & ~sup
+    def one(xb):
+        scores = xb[:, score_i]
+        cid = xb[:, id_i] if (not force and id_i >= 0) else None
+        keep = greedy_nms_keep(xb[:, coord:coord + 4], scores,
+                               scores > vthresh, cid, thresh, topk, force)
+        order = jnp.argsort(-scores)
+        return jnp.where(keep[order][:, None], xb[order], -1.0)
 
-    keep = lax.fori_loop(0, N, body, valid)
-    out = jnp.where(keep[..., None], xs, -1.0)
+    out = jax.vmap(one)(x)
     if squeeze:
         out = out[0]
     return (out,)

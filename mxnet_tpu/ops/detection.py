@@ -212,27 +212,11 @@ def _multibox_target(params, anchor, label, cls_pred):
     return (loc_t.astype(dt), loc_m.astype(dt), cls_t.astype(dt))
 
 
-def _greedy_nms(boxes, scores, valid, class_id, thresh, topk, force):
-    """Greedy NMS; returns keep mask (same order as inputs). Scores drive
-    priority; suppression only among same class unless force."""
-    N = boxes.shape[0]
-    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
-    b = boxes[order]
-    ious = box_iou_xyxy(b, b)
-    if not force and class_id is not None:
-        cid = class_id[order]
-        ious = jnp.where(cid[:, None] == cid[None, :], ious, 0.0)
-    keep0 = valid[order]
-    if topk > 0:
-        keep0 = keep0 & (jnp.arange(N) < topk)
+from .contrib_ops import greedy_nms_keep as _greedy_nms
 
-    def body(i, keep):
-        sup = (ious[i] > thresh) & (jnp.arange(N) > i) & keep[i]
-        return keep & ~sup
-
-    keep_sorted = lax.fori_loop(0, N, body, keep0)
-    keep = jnp.zeros((N,), bool).at[order].set(keep_sorted)
-    return keep
+# default NMS candidate cap when nms_topk is unset: bounds the IoU matrix
+# to (cap, cap) instead of (A, A) for large anchor grids (SSD300 A=8732)
+_NMS_CAND_CAP = 1024
 
 
 @register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
@@ -261,10 +245,19 @@ def _multibox_detection(params, cls_prob, loc_pred, anchor):
         score = jnp.max(scores, axis=0)
         valid = score >= threshold
         boxes = _decode_box(anchors, lp.reshape(A, 4), variances, clip)
-        out_id = jnp.where(valid, (cid - 1).astype(cp.dtype), -1.0)
+        # remove the background id from the class numbering: classes above
+        # bg_id shift down by one (bg_id=0 gives the reference's cid - 1)
+        out_id = jnp.where(valid, (cid - (cid > bg_id)).astype(cp.dtype),
+                           -1.0)
         if 0 < nms_threshold <= 1:
-            keep = _greedy_nms(boxes, score, valid, cid, nms_threshold,
-                               nms_topk, force)
+            # NMS over the top-k candidates only: (k,k) IoU matrix instead
+            # of (A,A); valid anchors beyond the cap count as suppressed
+            # (reference nms_topk semantics)
+            k = min(A, nms_topk if nms_topk > 0 else _NMS_CAND_CAP)
+            top_scr, sel = lax.top_k(jnp.where(valid, score, -jnp.inf), k)
+            keep_k = _greedy_nms(boxes[sel], top_scr, jnp.isfinite(top_scr),
+                                 cid[sel], nms_threshold, -1, force)
+            keep = jnp.zeros((A,), bool).at[sel].set(keep_k)
             out_id = jnp.where(valid & ~keep, -1.0, out_id)
         rows = jnp.concatenate(
             [out_id[:, None], score[:, None], boxes], axis=1)
@@ -499,8 +492,11 @@ def _correlation(params, data1, data2):
 
 @register("Correlation1D")
 def _correlation1d(params, data1, data2):
-    """Fork op: horizontal-only correlation (stereo) —
-    src/operator/correlation1D.cc."""
+    """Fork op: horizontal-displacement correlation (stereo) —
+    src/operator/correlation1D.cu:38-95. Displacements are horizontal only
+    but each tap still sums a 2-D kernel_size^2 window over the channel
+    dim; output height shrinks by 2*kernel_radius
+    (correlation1D-inl.h:84-86)."""
     ksize = int(params.get("kernel_size", 1))
     max_d = int(params.get("max_displacement", 1))
     stride1 = int(params.get("stride1", 1))
@@ -521,16 +517,19 @@ def _correlation1d(params, data1, data2):
     p2 = jnp.pad(data2, ((0, 0), (0, 0), (0, 0), (pad, pad)))
     pw = W + 2 * pad
     border = max_d + kr
+    oh = int(math.ceil((H - 2 * kr) / float(stride1)))
     ow = int(math.ceil((pw - border * 2) / float(stride1)))
+    ys = kr + jnp.arange(oh) * stride1
     xs = border + jnp.arange(ow) * stride1
 
     def corr_at(dx):
         acc = 0.0
-        for kx in range(-kr, kr + 1):
-            a = p1[:, :, :, xs + kx]
-            b = p2[:, :, :, xs + dx + kx]
-            acc = acc + (a * b if mult else jnp.abs(a - b))
-        return jnp.sum(acc, axis=1) / (ksize * C)
+        for ky in range(-kr, kr + 1):
+            for kx in range(-kr, kr + 1):
+                a = p1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                b = p2[:, :, ys[:, None] + ky, xs[None, :] + dx + kx]
+                acc = acc + (a * b if mult else jnp.abs(a - b))
+        return jnp.sum(acc, axis=1) / (ksize * ksize * C)
 
     return (jnp.stack([corr_at(dx) for dx in disps], axis=1
                       ).astype(data1.dtype),)

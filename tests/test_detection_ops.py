@@ -265,3 +265,35 @@ def test_deformable_conv_grouped():
         jnp.asarray(data), jnp.asarray(weight), (1, 1), "VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=2)
     np.testing.assert_allclose(out, np.asarray(want), rtol=1e-3, atol=1e-4)
+
+
+def test_correlation1d_kernel3_window_and_shape():
+    rng = np.random.RandomState(10)
+    a = rng.randn(1, 2, 6, 8).astype(np.float32)
+    b = rng.randn(1, 2, 6, 8).astype(np.float32)
+    (out,) = run_op("Correlation1D",
+                    {"kernel_size": 3, "max_displacement": 1, "stride1": 1,
+                     "stride2": 1, "pad_size": 2}, a, b)
+    # channels = 2d+1 = 3; height shrinks by 2*kr = 2
+    assert out.shape == (1, 3, 4, out.shape[3])
+    # oracle at zero displacement, interior position (y=1 center -> rows
+    # 0..2), x center c: mean over 3x3 window and channels of a*b
+    kr, border = 1, 2
+    pa = np.pad(a, ((0, 0), (0, 0), (0, 0), (2, 2)))
+    pb = np.pad(b, ((0, 0), (0, 0), (0, 0), (2, 2)))
+    y, xo = 0, 0
+    yc, xc = y + kr, xo + border
+    want = (pa[0, :, yc - 1:yc + 2, xc - 1:xc + 2]
+            * pb[0, :, yc - 1:yc + 2, xc - 1:xc + 2]).sum() / (9 * 2)
+    np.testing.assert_allclose(out[0, 1, y, xo], want, rtol=1e-4)
+
+
+def test_multibox_detection_nonzero_background_id():
+    anchors = np.asarray([[[0.1, 0.1, 0.3, 0.3]]], np.float32)
+    loc_pred = np.zeros((1, 4), np.float32)
+    # 3 classes, background is class 2; class 0 wins with 0.9
+    cls_prob = np.asarray([[[0.9], [0.05], [0.05]]], np.float32)
+    (out,) = run_op("_contrib_MultiBoxDetection",
+                    {"background_id": 2}, cls_prob, loc_pred, anchors)
+    assert out[0, 0, 0] == 0.0          # class 0 keeps id 0
+    assert out[0, 0, 1] == np.float32(0.9)

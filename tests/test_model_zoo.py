@@ -1,0 +1,63 @@
+"""Model zoo structural tests (reference
+tests/python/gpu/test_gluon_model_zoo_gpu.py runs forwards; here we check
+construction, forward shapes, param counts, and hybridize consistency on
+the cheap models)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def _param_count(net):
+    # exclude BN running stats (aux states, grad_req='null') to match the
+    # usual trainable-parameter counts
+    return sum(int(np.prod(p.shape)) for p in net.collect_params().values()
+               if getattr(p, "grad_req", "write") != "null")
+
+
+def test_get_model_registry_has_new_families():
+    for name in ["densenet121", "densenet169", "densenet201", "densenet161",
+                 "inceptionv3"]:
+        net = vision.get_model(name)
+        assert net is not None
+
+
+def test_densenet121_forward_and_param_count():
+    net = vision.densenet121()
+    net.initialize()
+    out = net(mx.nd.zeros((2, 3, 224, 224)))
+    assert out.shape == (2, 1000)
+    # torchvision densenet121 = 7,978,856 params
+    assert abs(_param_count(net) - 7_978_856) < 20_000
+
+
+def test_inception_v3_forward_and_param_count():
+    net = vision.inception_v3()
+    net.initialize()
+    out = net(mx.nd.zeros((1, 3, 299, 299)))
+    assert out.shape == (1, 1000)
+    # reference gluon inception v3 (no aux head) ~= 23.8M params
+    assert 23_000_000 < _param_count(net) < 25_000_000
+
+
+def test_densenet_hybridize_matches_eager():
+    net = vision.densenet121(classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(1, 3, 224, 224)
+                    .astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-4)
+
+
+def test_hybrid_concurrent_and_identity():
+    from mxnet_tpu.gluon.contrib.nn import HybridConcurrent, Identity
+    block = HybridConcurrent(axis=1)
+    block.add(Identity())
+    block.add(Identity())
+    block.initialize()
+    x = mx.nd.ones((2, 3, 4, 4))
+    out = block(x)
+    assert out.shape == (2, 6, 4, 4)
