@@ -47,6 +47,7 @@ from .monitor import Monitor
 from . import test_utils
 from . import parallel
 from . import rtc
+from . import operator
 from .attribute import AttrScope
 from .name import NameManager
 

@@ -1,0 +1,248 @@
+"""Python custom operators — `mx.operator.CustomOp` / `CustomOpProp`.
+
+API parity with reference `python/mxnet/operator.py:426,472,692`
+(`CustomOp`, `CustomOpProp`, `register`): users subclass CustomOp
+(imperative forward/backward over NDArrays), describe it with a
+CustomOpProp, register it by name, and call it as
+`mx.nd.Custom(..., op_type=name)` or `mx.sym.Custom(...)`.
+
+TPU-native execution: the reference runs custom ops on a dedicated worker
+thread outside the engine (`src/operator/custom/custom-inl.h:50,94,153`,
+`ExecType::kAsync`); here the Python body runs on the HOST via
+`jax.pure_callback`, so a custom op works both eagerly and inside a jitted
+graph (the XLA program calls back into Python at that node — the same
+escape-hatch role the reference's worker thread plays). Gradients route
+through `jax.custom_vjp` into `CustomOp.backward`.
+
+Limitations (documented, reference-visible): auxiliary states are passed
+as extra inputs but their in-place mutation does not propagate out of a
+jitted graph, and `pure_callback` host transfers make custom ops a
+host-roundtrip per call — same perf caveat as the reference's GIL-bound
+custom-op thread.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import ndarray as _nd
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+_PROPS = {}
+
+
+class CustomOp(object):
+    """Base class for imperative custom operators
+    (reference operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign `src` to `dst` honoring the write request type."""
+        if req == "null":
+            return
+        if isinstance(src, _nd.NDArray):
+            src = src._data
+        src = jnp.asarray(src)
+        if req in ("write", "inplace"):
+            dst._data = src.astype(dst.dtype)
+        elif req == "add":
+            dst._data = (dst._data + src).astype(dst.dtype)
+        else:
+            raise ValueError("unknown req %r" % (req,))
+
+
+class CustomOpProp(object):
+    """Operator property: shapes/types/graph metadata
+    (reference operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under `reg_name`
+    (reference operator.py:692)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("%s must subclass CustomOpProp" % prop_cls)
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop_cls(name):
+    try:
+        return _PROPS[name]
+    except KeyError:
+        raise MXNetError("custom op %r is not registered" % name) from None
+
+
+def _build_prop(op_type, kwargs):
+    cls = get_prop_cls(op_type)
+    try:
+        return cls(**kwargs)
+    except TypeError:
+        # reference passes all kwargs as strings; retry with str values
+        return cls(**{k: str(v) for k, v in kwargs.items()})
+
+
+def _wrap(arrs):
+    return [_nd.NDArray(jnp.asarray(a)) for a in arrs]
+
+
+def _custom_n_out(params):
+    return len(_prop_from_ptuple(_hashable(params)).list_outputs())
+
+
+def _hashable(params):
+    # drop framework-injected keys (_is_train, _rng_key, ...); non-scalar
+    # values are stringified (the reference passes ALL kwargs as strings)
+    return tuple(sorted(
+        (k, v if isinstance(v, (int, float, bool, str)) else str(v))
+        for k, v in params.items() if not k.startswith("_")))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _custom_call(ptuple, is_train, *inputs):
+    return _custom_fwd_impl(ptuple, is_train, inputs)
+
+
+def _shapes_dtypes(prop, inputs):
+    n_args = len(prop.list_arguments())
+    in_shapes = [list(x.shape) for x in inputs[:n_args]]
+    out_shapes = prop.infer_shape(in_shapes)[1]
+    dtypes = prop.infer_type([x.dtype for x in inputs[:n_args]])[1]
+    return ([jax.ShapeDtypeStruct(tuple(s), d)
+             for s, d in zip(out_shapes, dtypes)], n_args)
+
+
+@functools.lru_cache(maxsize=256)
+def _prop_from_ptuple(ptuple):
+    d = dict(ptuple)
+    op_type = d.pop("op_type", None)
+    if not op_type:
+        raise MXNetError("Custom op requires op_type=<registered name>")
+    return _build_prop(op_type, d)
+
+
+def _custom_fwd_impl(ptuple, is_train, inputs):
+    prop = _prop_from_ptuple(ptuple)
+    result_shapes, n_args = _shapes_dtypes(prop, inputs)
+
+    def host_fn(*arrs):
+        p = _prop_from_ptuple(ptuple)
+        op = p.create_operator(None, [list(a.shape) for a in arrs[:n_args]],
+                               [a.dtype for a in arrs[:n_args]])
+        in_data = _wrap(arrs[:n_args])
+        aux = _wrap(arrs[n_args:])
+        out_data = [_nd.NDArray(jnp.zeros(rs.shape, rs.dtype))
+                    for rs in result_shapes]
+        op.forward(is_train, ["write"] * len(out_data), in_data, out_data,
+                   aux)
+        return tuple(np.asarray(o._data) for o in out_data)
+
+    outs = jax.pure_callback(host_fn, tuple(result_shapes), *inputs)
+    return outs
+
+
+def _custom_vjp_fwd(ptuple, is_train, *inputs):
+    outs = _custom_fwd_impl(ptuple, is_train, inputs)
+    return outs, (inputs, outs)
+
+
+def _custom_vjp_bwd(ptuple, is_train, res, gs):
+    inputs, outs = res
+    prop = _prop_from_ptuple(ptuple)
+    n_args = len(prop.list_arguments())
+    grad_shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                        for x in inputs)
+
+    def host_fn(*arrs):
+        gs_ = arrs[:len(outs)]
+        ins = arrs[len(outs):len(outs) + len(inputs)]
+        outs_ = arrs[len(outs) + len(inputs):]
+        p = _prop_from_ptuple(ptuple)
+        op = p.create_operator(None,
+                               [list(a.shape) for a in ins[:n_args]],
+                               [a.dtype for a in ins[:n_args]])
+        in_data = _wrap(ins[:n_args])
+        aux = _wrap(ins[n_args:])
+        out_data = _wrap(outs_)
+        out_grad = _wrap(gs_)
+        in_grad = [_nd.NDArray(jnp.zeros(a.shape, a.dtype))
+                   for a in ins[:n_args]]
+        op.backward(["write"] * len(in_grad), out_grad, in_data, out_data,
+                    in_grad, aux)
+        grads = [np.asarray(g._data) for g in in_grad]
+        # aux inputs receive zero gradient
+        grads.extend(np.zeros(a.shape, a.dtype) for a in ins[n_args:])
+        return tuple(grads)
+
+    grads = jax.pure_callback(host_fn, grad_shapes, *gs, *inputs, *outs)
+    return grads
+
+
+_custom_call.defvjp(_custom_vjp_fwd, _custom_vjp_bwd)
+
+
+@_register_op("Custom", num_outputs=_custom_n_out, need_train_flag=True)
+def _custom(params, *inputs):
+    """Reference src/operator/custom/custom.cc: dispatch to a registered
+    Python CustomOpProp/CustomOp pair."""
+    is_train = bool(params.get("_is_train", False))
+    outs = _custom_call(_hashable(params), is_train, *inputs)
+    return tuple(outs)
+
+
+# "Custom" registered after the nd/sym namespaces were generated at package
+# import; refresh them so mx.nd.Custom / mx.sym.Custom exist.
+def _refresh_frontends():
+    from . import ndarray as _ndpkg
+    from . import symbol as _sympkg
+    from .ndarray.register import populate as _npop
+    from .symbol.register import populate as _spop
+    _npop(vars(_ndpkg))
+    _spop(vars(_sympkg))
+
+
+_refresh_frontends()

@@ -214,10 +214,6 @@ def _multibox_target(params, anchor, label, cls_pred):
 
 from .contrib_ops import greedy_nms_keep as _greedy_nms
 
-# default NMS candidate cap when nms_topk is unset: bounds the IoU matrix
-# to (cap, cap) instead of (A, A) for large anchor grids (SSD300 A=8732)
-_NMS_CAND_CAP = 1024
-
 
 @register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
 def _multibox_detection(params, cls_prob, loc_pred, anchor):
@@ -250,14 +246,21 @@ def _multibox_detection(params, cls_prob, loc_pred, anchor):
         out_id = jnp.where(valid, (cid - (cid > bg_id)).astype(cp.dtype),
                            -1.0)
         if 0 < nms_threshold <= 1:
-            # NMS over the top-k candidates only: (k,k) IoU matrix instead
-            # of (A,A); valid anchors beyond the cap count as suppressed
-            # (reference nms_topk semantics)
-            k = min(A, nms_topk if nms_topk > 0 else _NMS_CAND_CAP)
-            top_scr, sel = lax.top_k(jnp.where(valid, score, -jnp.inf), k)
-            keep_k = _greedy_nms(boxes[sel], top_scr, jnp.isfinite(top_scr),
-                                 cid[sel], nms_threshold, -1, force)
-            keep = jnp.zeros((A,), bool).at[sel].set(keep_k)
+            if nms_topk > 0:
+                # NMS over the top-k candidates only: (k,k) IoU matrix
+                # instead of (A,A); valid anchors beyond topk count as
+                # suppressed (reference nms_topk semantics). Set nms_topk
+                # on large anchor grids — unset, the IoU matrix is (A,A).
+                k = min(A, nms_topk)
+                top_scr, sel = lax.top_k(
+                    jnp.where(valid, score, -jnp.inf), k)
+                keep_k = _greedy_nms(boxes[sel], top_scr,
+                                     jnp.isfinite(top_scr), cid[sel],
+                                     nms_threshold, -1, force)
+                keep = jnp.zeros((A,), bool).at[sel].set(keep_k)
+            else:
+                keep = _greedy_nms(boxes, score, valid, cid,
+                                   nms_threshold, -1, force)
             out_id = jnp.where(valid & ~keep, -1.0, out_id)
         rows = jnp.concatenate(
             [out_id[:, None], score[:, None], boxes], axis=1)

@@ -1,0 +1,105 @@
+"""Custom Python operator tests (mirror reference
+tests/python/unittest/test_operator.py::test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@mx.operator.register("tsigmoid")
+class TSigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TSigmoid()
+
+
+class TSigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1.0 - y))
+
+
+@mx.operator.register("tsplit2")
+class TSplit2Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TSplit2()
+
+
+class TSplit2(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], x * 2)
+        self.assign(out_data[1], req[1], x + 1)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0].asnumpy() * 2 + out_grad[1].asnumpy())
+
+
+def test_custom_forward_eager():
+    x = mx.nd.array(np.asarray([[-1.0, 0.0, 2.0]], np.float32))
+    y = mx.nd.Custom(x, op_type="tsigmoid")
+    np.testing.assert_allclose(y.asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-6)
+
+
+def test_custom_backward_autograd():
+    x = mx.nd.array(np.asarray([[-1.0, 0.5, 2.0]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="tsigmoid")
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_custom_multi_output():
+    xv = np.arange(4, dtype=np.float32).reshape(2, 2)
+    a, b = mx.nd.Custom(mx.nd.array(xv), op_type="tsplit2")
+    np.testing.assert_allclose(a.asnumpy(), xv * 2)
+    np.testing.assert_allclose(b.asnumpy(), xv + 1)
+
+
+def test_custom_in_symbol_executor():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Custom(data, op_type="tsigmoid", name="sig")
+    ex = out.simple_bind(mx.cpu(), data=(2, 3))
+    xv = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    ex.forward(is_train=True, data=mx.nd.array(xv))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               1 / (1 + np.exp(-xv)), rtol=1e-5)
+    ex.backward(mx.nd.ones((2, 3)))
+    s = 1 / (1 + np.exp(-xv))
+    np.testing.assert_allclose(ex.grad_arrays[0].asnumpy(), s * (1 - s),
+                               rtol=1e-5)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.Custom(mx.nd.ones((1,)), op_type="definitely_missing")
