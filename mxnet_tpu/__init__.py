@@ -48,6 +48,7 @@ from . import test_utils
 from . import parallel
 from . import rtc
 from . import operator
+from . import contrib
 from .attribute import AttrScope
 from .name import NameManager
 

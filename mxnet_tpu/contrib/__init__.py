@@ -1,0 +1,2 @@
+"""Contrib frontend modules (reference python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,147 @@
+"""Int8 quantization operators.
+
+Parity targets: reference `src/operator/quantization/` — quantize,
+dequantize, requantize, quantized_conv, quantized_fully_connected,
+quantized_pooling, quantized_flatten (`quantize-inl.h`,
+`requantize-inl.h`, `quantized_conv.cu`, `quantized_fully_connected.cc`).
+
+TPU mapping: int8 lives as jnp.int8; the MXU multiplies int8 pairs into
+int32 accumulators via `preferred_element_type=jnp.int32` on
+dot_general/conv — the same int8->int32 contract as cuDNN/cuBLAS int8
+paths. Ranges travel as (min, max) scalar tensors exactly like the
+reference's three-tensor convention. Symmetric signed quantization:
+scale = 127 / max(|min|, |max|).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+INT8_RANGE = 127.0
+INT32_RANGE = float(2 ** 31 - 1)
+
+
+def _real_range(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def _to_int8(data, real_range):
+    scale = INT8_RANGE / jnp.maximum(real_range, 1e-30)
+    q = jnp.clip(jnp.round(data * scale), -INT8_RANGE, INT8_RANGE)
+    return q.astype(jnp.int8)
+
+
+@register("_contrib_quantize", num_outputs=3, aliases=("quantize",))
+def _quantize(params, data, min_range, max_range):
+    """data fp32 + explicit range -> (int8, min_out, max_out)."""
+    r = _real_range(min_range.reshape(()), max_range.reshape(()))
+    q = _to_int8(data, r)
+    return (q, (-r).reshape(1), r.reshape(1))
+
+
+@register("_contrib_quantize_v2", num_outputs=3, aliases=("quantize_v2",))
+def _quantize_v2(params, data):
+    """Range computed from the data (or min/max_calib_range attrs)."""
+    mn = params.get("min_calib_range")
+    mx = params.get("max_calib_range")
+    if mn is not None and mx is not None:
+        r = jnp.maximum(abs(float(mn)), abs(float(mx)))
+        r = jnp.asarray(r, jnp.float32)
+    else:
+        r = _real_range(jnp.min(data), jnp.max(data))
+    q = _to_int8(data, r)
+    return (q, (-r).reshape(1), r.reshape(1))
+
+
+@register("_contrib_dequantize", aliases=("dequantize",))
+def _dequantize(params, data, min_range, max_range):
+    r = _real_range(min_range.reshape(()), max_range.reshape(()))
+    if data.dtype == jnp.int8:
+        scale = r / INT8_RANGE
+    else:  # int32
+        scale = r / INT32_RANGE
+    return (data.astype(jnp.float32) * scale,)
+
+
+@register("_contrib_requantize", num_outputs=3, aliases=("requantize",))
+def _requantize(params, data, min_range, max_range):
+    """int32 -> int8. With min/max_calib_range attrs the output range is
+    the calibrated one; otherwise it derives from the observed max."""
+    r_in = _real_range(min_range.reshape(()), max_range.reshape(()))
+    real = data.astype(jnp.float32) * (r_in / INT32_RANGE)
+    mn = params.get("min_calib_range")
+    mx = params.get("max_calib_range")
+    if mn is not None and mx is not None:
+        r_out = jnp.asarray(max(abs(float(mn)), abs(float(mx))), jnp.float32)
+    else:
+        r_out = jnp.max(jnp.abs(real))
+    q = _to_int8(real, r_out)
+    return (q, (-r_out).reshape(1), r_out.reshape(1))
+
+
+def _q_out_range(dmin, dmax, wmin, wmax):
+    """Output (min,max) for an int8*int8->int32 op: int32 counts scale by
+    sx*sw, so the representable range is ±INT32_RANGE*sx*sw
+    (reference quantization_utils.h kInt32Range convention)."""
+    sx = _real_range(dmin.reshape(()), dmax.reshape(())) / INT8_RANGE
+    sw = _real_range(wmin.reshape(()), wmax.reshape(())) / INT8_RANGE
+    r = INT32_RANGE * sx * sw
+    return (-r).reshape(1), r.reshape(1)
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          aliases=("quantized_fully_connected",))
+def _quantized_fc(params, data, weight, dmin, dmax, wmin, wmax):
+    """int8 x int8 -> int32 FC on the MXU. Bias is intentionally not an
+    input: the graph pass adds it in fp32 after dequantize (numerically
+    equivalent; avoids the reference's bias re-quantization)."""
+    x = data.reshape(data.shape[0], -1) if params.get("flatten", True) \
+        and data.ndim > 2 else data
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    omin, omax = _q_out_range(dmin, dmax, wmin, wmax)
+    return (out, omin, omax)
+
+
+@register("_contrib_quantized_conv", num_outputs=3,
+          aliases=("quantized_conv",))
+def _quantized_conv(params, data, weight, dmin, dmax, wmin, wmax):
+    """int8 NCHW conv with int32 accumulation."""
+    from .nn import _tup
+    stride = _tup(params.get("stride"), 2, 1)
+    pad = _tup(params.get("pad"), 2, 0)
+    dilate = _tup(params.get("dilate"), 2, 1)
+    groups = int(params.get("num_group", 1))
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    omin, omax = _q_out_range(dmin, dmax, wmin, wmax)
+    return (out, omin, omax)
+
+
+@register("_contrib_quantized_pooling", num_outputs=3,
+          aliases=("quantized_pooling",))
+def _quantized_pooling(params, data, dmin, dmax):
+    """Pooling on int8 keeps the input range (max pool exactly; avg pool
+    via int32 accumulation then int8 round)."""
+    from .nn import _pooling
+    out = _pooling(dict(params), data.astype(jnp.float32))[0]
+    if params.get("pool_type", "max") == "max":
+        out = out.astype(jnp.int8)
+    else:
+        out = jnp.clip(jnp.round(out), -INT8_RANGE, INT8_RANGE
+                       ).astype(jnp.int8)
+    return (out, dmin, dmax)
+
+
+@register("_contrib_quantized_flatten", num_outputs=3,
+          aliases=("quantized_flatten",))
+def _quantized_flatten(params, data, dmin, dmax):
+    return (data.reshape(data.shape[0], -1), dmin, dmax)
