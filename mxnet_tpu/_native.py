@@ -1,0 +1,106 @@
+"""Loader for the native runtime library (libmxtpu.so).
+
+The native library provides the host-side components the reference
+implements in C++ (dmlc recordio, the threaded image IO pipeline of
+`src/io/iter_image_recordio_2.cc`, and the COCO mask API of
+`src/coco_api/`). Pure-Python fallbacks exist for every consumer, so the
+framework stays importable if the library is missing; `lib()` returns
+None in that case. If the `.so` is absent but a toolchain is available
+the loader builds it once from `src/` (g++ is part of the supported
+environment).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB = None
+_TRIED = False
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "native", "libmxtpu.so")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "src")
+
+
+def _declare(lib):
+    c = ctypes
+    lib.MXTGetLastError.restype = c.c_char_p
+    lib.MXTGetLastError.argtypes = []
+    lib.MXTGetVersion.argtypes = [c.POINTER(c.c_int)]
+
+    h = c.c_void_p
+    sz = c.c_size_t
+    lib.MXTRecordIOWriterCreate.argtypes = [c.c_char_p, c.POINTER(h)]
+    lib.MXTRecordIOWriterFree.argtypes = [h]
+    lib.MXTRecordIOWriterWriteRecord.argtypes = [h, c.c_char_p, sz]
+    lib.MXTRecordIOWriterTell.argtypes = [h, c.POINTER(sz)]
+    lib.MXTRecordIOReaderCreate.argtypes = [c.c_char_p, c.POINTER(h)]
+    lib.MXTRecordIOReaderFree.argtypes = [h]
+    lib.MXTRecordIOReaderReadRecord.argtypes = [
+        h, c.POINTER(c.POINTER(c.c_char)), c.POINTER(sz)]
+    lib.MXTRecordIOReaderSeek.argtypes = [h, sz]
+    lib.MXTRecordIOReaderTell.argtypes = [h, c.POINTER(sz)]
+
+    u8p = c.POINTER(c.c_ubyte)
+    lib.MXTImageDecode.argtypes = [c.c_char_p, sz, c.c_int,
+                                   c.POINTER(c.c_int), c.POINTER(c.c_int),
+                                   c.POINTER(c.c_int), u8p]
+    lib.MXTImageEncodeJPEG.argtypes = [u8p, c.c_int, c.c_int, c.c_int,
+                                       c.c_int, c.c_char_p, c.POINTER(sz)]
+    lib.MXTImageResize.argtypes = [u8p, c.c_int, c.c_int, c.c_int, u8p,
+                                   c.c_int, c.c_int]
+
+    f32p = c.POINTER(c.c_float)
+    lib.MXTImagePipelineCreate.argtypes = [
+        c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_uint64, f32p, f32p, c.c_int,
+        c.c_int, c.POINTER(h)]
+    lib.MXTImagePipelineFree.argtypes = [h]
+    lib.MXTImagePipelineNext.argtypes = [h, f32p, f32p, c.POINTER(c.c_int),
+                                         c.POINTER(c.c_int)]
+    lib.MXTImagePipelineReset.argtypes = [h]
+
+    u32p = c.POINTER(c.c_uint32)
+    szp = c.POINTER(sz)
+    lib.MXTMaskEncode.argtypes = [u8p, c.c_int, c.c_int, u32p, szp]
+    lib.MXTMaskDecode.argtypes = [u32p, sz, c.c_int, c.c_int, u8p]
+    lib.MXTMaskArea.argtypes = [u32p, sz, c.POINTER(c.c_uint32)]
+    lib.MXTMaskMerge.argtypes = [u32p, szp, c.c_int, c.c_int, c.c_int,
+                                 c.c_int, u32p, szp]
+    lib.MXTMaskIoU.argtypes = [u32p, szp, c.c_int, u32p, szp, c.c_int,
+                               c.c_int, c.c_int, u8p, c.POINTER(c.c_double)]
+    lib.MXTMaskFrPoly.argtypes = [c.POINTER(c.c_double), sz, c.c_int, c.c_int,
+                                  u32p, szp]
+    return lib
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-s"], cwd=_SRC_DIR, check=True,
+                       capture_output=True, timeout=300)
+        return os.path.isfile(_SO_PATH)
+    except Exception:
+        return False
+
+
+def lib():
+    """Return the loaded native library, or None if unavailable."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.isfile(_SO_PATH) and os.path.isdir(_SRC_DIR):
+        _build()
+    if os.path.isfile(_SO_PATH):
+        try:
+            _LIB = _declare(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def check_call(ret):
+    """Raise MXNetError on nonzero return (reference c_api convention)."""
+    if ret != 0:
+        from .base import MXNetError
+        raise MXNetError(lib().MXTGetLastError().decode("utf-8", "replace"))

@@ -1,0 +1,197 @@
+"""COCO RLE mask utilities backed by the native library.
+
+Capability parity with the reference's vendored COCO mask API
+(`src/coco_api/common/maskApi.h`, consumed by
+`src/operator/proposal_mask_target.cc` for Mask-R-CNN-style training).
+RLE objects are dicts {"size": [h, w], "counts": uint32 array} with COCO's
+column-major convention. NumPy fallbacks are provided when the native
+library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ._native import lib as _lib, check_call
+
+__all__ = ["encode", "decode", "area", "merge", "iou", "frPoly"]
+
+
+def _np_encode_one(m):
+    flat = np.asfortranarray(m).ravel(order="F").astype(bool)
+    # run-length over the flattened column-major mask, starting with zeros
+    changes = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+    bounds = np.concatenate([[0], changes, [flat.size]])
+    counts = np.diff(bounds).astype(np.uint32)
+    if flat.size and flat[0]:
+        counts = np.concatenate([[np.uint32(0)], counts])
+    return counts
+
+
+def encode(mask):
+    """Encode binary mask(s) to RLE. mask: (h, w) or (h, w, n) uint8."""
+    mask = np.asarray(mask, dtype=np.uint8)
+    single = mask.ndim == 2
+    if single:
+        mask = mask[:, :, None]
+    h, w, n = mask.shape
+    out = []
+    native = _lib()
+    for i in range(n):
+        col = np.asfortranarray(mask[:, :, i]).ravel(order="F")
+        if native is not None:
+            col = np.ascontiguousarray(col)
+            # worst-case RLE length is h*w+1 (alternating pixels with a
+            # leading zero run), so one call with that buffer suffices
+            ln = ctypes.c_size_t(h * w + 1)
+            u8p = ctypes.POINTER(ctypes.c_ubyte)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            buf = np.empty(h * w + 1, dtype=np.uint32)
+            check_call(native.MXTMaskEncode(
+                col.ctypes.data_as(u8p), h, w,
+                buf.ctypes.data_as(u32p), ctypes.byref(ln)))
+            counts = buf[:ln.value].copy()
+        else:
+            counts = _np_encode_one(mask[:, :, i])
+        out.append({"size": [h, w], "counts": counts})
+    return out[0] if single else out
+
+
+def decode(rles):
+    """Decode RLE(s) to binary mask(s): (h, w) or (h, w, n) uint8."""
+    single = isinstance(rles, dict)
+    if single:
+        rles = [rles]
+    h, w = rles[0]["size"]
+    out = np.zeros((h, w, len(rles)), dtype=np.uint8, order="F")
+    native = _lib()
+    for i, r in enumerate(rles):
+        counts = np.ascontiguousarray(r["counts"], dtype=np.uint32)
+        if native is not None:
+            flat = np.empty(h * w, dtype=np.uint8)
+            u8p = ctypes.POINTER(ctypes.c_ubyte)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            check_call(native.MXTMaskDecode(
+                counts.ctypes.data_as(u32p), counts.size, h, w,
+                flat.ctypes.data_as(u8p)))
+        else:
+            flat = np.repeat(
+                np.arange(counts.size, dtype=np.int64) % 2,
+                counts.astype(np.int64)).astype(np.uint8)
+        out[:, :, i] = flat.reshape(h, w, order="F")
+    return out[:, :, 0] if single else out
+
+
+def area(rles):
+    single = isinstance(rles, dict)
+    if single:
+        rles = [rles]
+    out = np.array([int(np.asarray(r["counts"], dtype=np.uint64)[1::2].sum())
+                    for r in rles], dtype=np.uint32)
+    return int(out[0]) if single else out
+
+
+def merge(rles, intersect=False):
+    """Merge a list of RLEs with OR (default) or AND."""
+    h, w = rles[0]["size"]
+    native = _lib()
+    if native is not None:
+        counts = np.concatenate([np.ascontiguousarray(r["counts"],
+                                                      dtype=np.uint32)
+                                 for r in rles])
+        lens = np.array([len(r["counts"]) for r in rles], dtype=np.uintp)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        szp = ctypes.POINTER(ctypes.c_size_t)
+        ln = ctypes.c_size_t(h * w + 1)
+        out = np.empty(h * w + 1, dtype=np.uint32)
+        check_call(native.MXTMaskMerge(
+            counts.ctypes.data_as(u32p), lens.ctypes.data_as(szp),
+            len(rles), h, w, 1 if intersect else 0,
+            out.ctypes.data_as(u32p), ctypes.byref(ln)))
+        return {"size": [h, w], "counts": out[:ln.value].copy()}
+    masks = decode(rles)
+    acc = masks.all(axis=2) if intersect else masks.any(axis=2)
+    return encode(acc.astype(np.uint8))
+
+
+def iou(dt, gt, iscrowd=None):
+    """Pairwise IoU: rows = dt, cols = gt. iscrowd[j] uses the crowd
+    denominator (area of dt) per the COCO convention."""
+    if isinstance(dt, dict):
+        dt = [dt]
+    if isinstance(gt, dict):
+        gt = [gt]
+    h, w = dt[0]["size"]
+    native = _lib()
+    out = np.zeros((len(dt), len(gt)), dtype=np.float64)
+    if native is not None:
+        a = np.concatenate([np.ascontiguousarray(r["counts"], dtype=np.uint32)
+                            for r in dt])
+        b = np.concatenate([np.ascontiguousarray(r["counts"], dtype=np.uint32)
+                            for r in gt])
+        alens = np.array([len(r["counts"]) for r in dt], dtype=np.uintp)
+        blens = np.array([len(r["counts"]) for r in gt], dtype=np.uintp)
+        crowd = (np.ascontiguousarray(iscrowd, dtype=np.uint8)
+                 if iscrowd is not None else None)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        szp = ctypes.POINTER(ctypes.c_size_t)
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        check_call(native.MXTMaskIoU(
+            a.ctypes.data_as(u32p), alens.ctypes.data_as(szp), len(dt),
+            b.ctypes.data_as(u32p), blens.ctypes.data_as(szp), len(gt),
+            h, w, crowd.ctypes.data_as(u8p) if crowd is not None else None,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        return out
+    dm = decode(dt).astype(bool)
+    gm = decode(gt).astype(bool)
+    for i in range(len(dt)):
+        for j in range(len(gt)):
+            inter = np.logical_and(dm[:, :, i], gm[:, :, j]).sum()
+            if iscrowd is not None and iscrowd[j]:
+                denom = dm[:, :, i].sum()
+            else:
+                denom = np.logical_or(dm[:, :, i], gm[:, :, j]).sum()
+            out[i, j] = inter / denom if denom else 0.0
+    return out
+
+
+def frPoly(polys, h, w):
+    """Rasterize polygon(s) [x0,y0,x1,y1,...] to RLE(s)."""
+    single = polys and np.isscalar(polys[0])
+    if single:
+        polys = [polys]
+    native = _lib()
+    out = []
+    for poly in polys:
+        xy = np.ascontiguousarray(poly, dtype=np.float64)
+        k = xy.size // 2
+        if native is not None:
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            dp = ctypes.POINTER(ctypes.c_double)
+            ln = ctypes.c_size_t(h * w + 1)
+            buf = np.empty(h * w + 1, dtype=np.uint32)
+            check_call(native.MXTMaskFrPoly(
+                xy.ctypes.data_as(dp), k, h, w,
+                buf.ctypes.data_as(u32p), ctypes.byref(ln)))
+            out.append({"size": [h, w], "counts": buf[:ln.value].copy()})
+        else:
+            # even-odd scanline fill at pixel centers
+            pts = xy.reshape(-1, 2)
+            mask = np.zeros((h, w), dtype=np.uint8)
+            for y in range(h):
+                yc = y + 0.5
+                xs = []
+                for i in range(k):
+                    x0, y0 = pts[i]
+                    x1, y1 = pts[(i + 1) % k]
+                    if (y0 <= yc < y1) or (y1 <= yc < y0):
+                        xs.append(x0 + (yc - y0) / (y1 - y0) * (x1 - x0))
+                xs.sort()
+                for i in range(0, len(xs) - 1, 2):
+                    lo = max(0, int(np.ceil(xs[i] - 0.5)))
+                    hi = min(w - 1, int(np.floor(xs[i + 1] - 0.5)))
+                    if hi >= lo:
+                        mask[y, lo:hi + 1] = 1
+            out.append(encode(mask))
+    return out[0] if single else out

@@ -1,5 +1,12 @@
-"""JPEG/PNG encode/decode (reference uses OpenCV in `src/io/image_recordio.h`)."""
+"""JPEG/PNG encode/decode (reference uses OpenCV in `src/io/image_recordio.h`).
+
+Preferred backend is the native library (libjpeg/libpng via
+src/image_codec.cc) so the hot decode path has no Python-level
+dependency; cv2/PIL are fallbacks.
+"""
 from __future__ import annotations
+
+import ctypes
 
 import numpy as np
 
@@ -17,8 +24,31 @@ except Exception:  # pragma: no cover
     _HAS_PIL = False
 
 
+def _native_lib():
+    from .._native import lib
+    return lib()
+
+
 def imencode(img, img_fmt=".jpg", quality=95):
     """img: HWC uint8 BGR (cv2 convention, matching the reference)."""
+    lib = _native_lib()
+    if lib is not None and img_fmt in (".jpg", ".jpeg"):
+        from .._native import check_call
+        img = np.ascontiguousarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        h, w, c = img.shape
+        rgb = np.ascontiguousarray(img[..., ::-1]) if c == 3 else img
+        size = ctypes.c_size_t()
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        check_call(lib.MXTImageEncodeJPEG(
+            rgb.ctypes.data_as(u8p), h, w, c, quality, None,
+            ctypes.byref(size)))
+        out = ctypes.create_string_buffer(size.value)
+        check_call(lib.MXTImageEncodeJPEG(
+            rgb.ctypes.data_as(u8p), h, w, c, quality, out,
+            ctypes.byref(size)))
+        return out.raw[:size.value]
     if _HAS_CV2:
         params = [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg") \
             else [cv2.IMWRITE_PNG_COMPRESSION, quality]
@@ -35,6 +65,24 @@ def imencode(img, img_fmt=".jpg", quality=95):
 
 def imdecode_np(buf, iscolor=1, to_rgb=False):
     """Decode to HWC uint8. BGR by default (reference cv2 convention)."""
+    lib = _native_lib()
+    if lib is not None:
+        from .._native import check_call
+        buf = bytes(buf)
+        h = ctypes.c_int()
+        w = ctypes.c_int()
+        c = ctypes.c_int()
+        flag = 1 if iscolor != 0 else 0
+        check_call(lib.MXTImageDecode(buf, len(buf), flag, ctypes.byref(h),
+                                      ctypes.byref(w), ctypes.byref(c), None))
+        out = np.empty((h.value, w.value, c.value), dtype=np.uint8)
+        check_call(lib.MXTImageDecode(
+            buf, len(buf), flag, ctypes.byref(h), ctypes.byref(w),
+            ctypes.byref(c), out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))))
+        if c.value == 1:
+            return out[:, :, 0]
+        # native decodes RGB; reference cv2 convention is BGR
+        return out if to_rgb else out[..., ::-1]
     data = np.frombuffer(buf, dtype=np.uint8)
     if _HAS_CV2:
         flag = cv2.IMREAD_COLOR if iscolor != 0 else cv2.IMREAD_GRAYSCALE

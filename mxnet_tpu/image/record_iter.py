@@ -1,13 +1,15 @@
 """ImageRecordIter: multi-threaded RecordIO image pipeline.
 
 Parity with reference `src/io/iter_image_recordio_2.cc` (N decode threads +
-double-buffered prefetch into pinned batches). Python threads suffice here
-because cv2.imdecode releases the GIL; the prefetch depth hides decode
-latency behind device compute, and the resulting host batch is copied to
-device asynchronously by PJRT.
+double-buffered prefetch into pinned batches). The preferred backend is the
+native C++ pipeline (`src/image_pipeline.cc`: libjpeg decode threads +
+bounded prefetch queue, GIL never held) exposed as
+:class:`NativeImageRecordIter`; :class:`ImageRecordIterImpl` is the pure
+Python-thread fallback.
 """
 from __future__ import annotations
 
+import ctypes
 import os
 import threading
 import queue as _queue
@@ -18,6 +20,74 @@ from ..io import DataIter, DataBatch, DataDesc
 from ..ndarray import array
 from .. import recordio as rio
 from .codec import imdecode_np
+
+
+class NativeImageRecordIter(DataIter):
+    """C++-pipeline-backed record iterator (src/image_pipeline.cc)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
+                 label_width=1, mean_r=0, mean_g=0, mean_b=0, std_r=1,
+                 std_g=1, std_b=1, rand_crop=False, rand_mirror=False,
+                 resize=0, preprocess_threads=4, seed=0,
+                 data_name="data", label_name="softmax_label", part_index=0,
+                 num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        from .._native import lib, check_call
+        self._lib = lib()
+        assert self._lib is not None, "native library unavailable"
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        c, h, w = self.data_shape
+        mean = (ctypes.c_float * c)(*( [mean_r, mean_g, mean_b][:c] ))
+        std = (ctypes.c_float * c)(*( [std_r, std_g, std_b][:c] ))
+        use_norm = any(v != 0 for v in mean) or any(v != 1 for v in std)
+        handle = ctypes.c_void_p()
+        check_call(self._lib.MXTImagePipelineCreate(
+            path_imgrec.encode(), batch_size, h, w, c, label_width,
+            max(1, preprocess_threads), 1 if shuffle else 0,
+            1 if rand_crop else 0, 1 if rand_mirror else 0, int(resize),
+            int(seed), mean if use_norm else None, std if use_norm else None,
+            part_index, num_parts, ctypes.byref(handle)))
+        self._handle = handle
+        self._exhausted = False
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        if label_width > 1:
+            self.provide_label = [DataDesc(label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [DataDesc(label_name, (batch_size,))]
+        self._data_buf = np.empty((batch_size, c, h, w), np.float32)
+        self._label_buf = np.empty((batch_size, label_width), np.float32)
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.MXTImagePipelineFree(self._handle)
+            self._handle = None
+
+    def reset(self):
+        from .._native import check_call
+        check_call(self._lib.MXTImagePipelineReset(self._handle))
+        self._exhausted = False
+
+    def next(self):
+        # sticky EOF: the C++ coordinator blocks awaiting reset after the
+        # epoch-end marker, so a post-EOF native Next() would deadlock
+        if self._exhausted:
+            raise StopIteration
+        from .._native import check_call
+        pad = ctypes.c_int()
+        eof = ctypes.c_int()
+        f32p = ctypes.POINTER(ctypes.c_float)
+        check_call(self._lib.MXTImagePipelineNext(
+            self._handle, self._data_buf.ctypes.data_as(f32p),
+            self._label_buf.ctypes.data_as(f32p), ctypes.byref(pad),
+            ctypes.byref(eof)))
+        if eof.value:
+            self._exhausted = True
+            raise StopIteration
+        label = (self._label_buf[:, 0] if self.label_width == 1
+                 else self._label_buf)
+        return DataBatch(data=[array(self._data_buf.copy())],
+                         label=[array(label.copy())], pad=pad.value)
 
 
 class ImageRecordIterImpl(DataIter):
@@ -114,14 +184,21 @@ class ImageRecordIterImpl(DataIter):
         try:
             c, h, w = self.data_shape
             n = len(order)
-            for start in range(0, n - self.batch_size + 1, self.batch_size):
+            # round_batch semantics matching the native pipeline: the final
+            # partial batch wraps to the epoch start and reports pad
+            for start in range(0, n, self.batch_size):
                 if stop_evt.is_set():
                     return
                 data = np.empty((self.batch_size, c, h, w), np.float32)
                 label = np.empty((self.batch_size,), np.float32)
+                pad = 0
                 for j in range(self.batch_size):
-                    data[j], label[j] = self._decode_one(rec, order[start + j])
-                out_q.put((data, label))
+                    pos = start + j
+                    if pos >= n:
+                        pad += 1
+                        pos %= n
+                    data[j], label[j] = self._decode_one(rec, order[pos])
+                out_q.put((data, label, pad))
         finally:
             rec.close()
             out_q.put(None)
@@ -152,5 +229,5 @@ class ImageRecordIterImpl(DataIter):
         item = self._epoch_queue.get()
         if item is None:
             raise StopIteration
-        data, label = item
-        return DataBatch(data=[array(data)], label=[array(label)], pad=0)
+        data, label, pad = item
+        return DataBatch(data=[array(data)], label=[array(label)], pad=pad)

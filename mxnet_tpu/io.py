@@ -471,6 +471,13 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
     Implemented over `mxnet_tpu.recordio` + `mxnet_tpu.image`; see
     `mxnet_tpu/image/record_iter.py`.
     """
+    from ._native import lib
+    if lib() is not None:
+        from .image.record_iter import NativeImageRecordIter
+        return NativeImageRecordIter(path_imgrec=path_imgrec,
+                                     data_shape=data_shape,
+                                     batch_size=batch_size, shuffle=shuffle,
+                                     **kwargs)
     from .image.record_iter import ImageRecordIterImpl
     return ImageRecordIterImpl(path_imgrec=path_imgrec, data_shape=data_shape,
                                batch_size=batch_size, shuffle=shuffle, **kwargs)

@@ -32,26 +32,55 @@ _IR_SIZE = struct.calcsize(_IR_FORMAT)
 
 
 class MXRecordIO:
-    """Sequential record reader/writer (reference recordio.py MXRecordIO)."""
+    """Sequential record reader/writer (reference recordio.py MXRecordIO).
+
+    Backed by the native library when available (full dmlc framing
+    including multi-chunk records whose payload contains the magic word,
+    matching dmlc-core recordio); falls back to a pure-Python
+    single-chunk implementation otherwise.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.fid = None
+        self.handle = None
         self.writable = None
         self.open()
 
+    @property
+    def _native(self):
+        from . import _native
+        return _native.lib()
+
     def open(self):
+        lib = self._native
         if self.flag == "w":
-            self.fid = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        if lib is not None:
+            from ._native import check_call
+            handle = ctypes.c_void_p()
+            uri = self.uri.encode("utf-8")
+            if self.writable:
+                check_call(lib.MXTRecordIOWriterCreate(uri, ctypes.byref(handle)))
+            else:
+                check_call(lib.MXTRecordIOReaderCreate(uri, ctypes.byref(handle)))
+            self.handle = handle
+        else:
+            self.fid = open(self.uri, "wb" if self.writable else "rb")
 
     def close(self):
+        if self.handle is not None:
+            lib = self._native
+            if self.writable:
+                lib.MXTRecordIOWriterFree(self.handle)
+            else:
+                lib.MXTRecordIOReaderFree(self.handle)
+            self.handle = None
         if self.fid is not None and not self.fid.closed:
             self.fid.close()
 
@@ -61,8 +90,10 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["fid"] = None
+        d["handle"] = None
         if not self.writable:
-            d["_pos"] = self.fid.tell() if self.fid and not self.fid.closed else 0
+            d["_pos"] = self.tell() if (self.handle or
+                                        (self.fid and not self.fid.closed)) else 0
         return d
 
     def __setstate__(self, d):
@@ -70,7 +101,7 @@ class MXRecordIO:
         self.__dict__.update(d)
         self.open()
         if not self.writable:
-            self.fid.seek(pos)
+            self.seek(pos)
 
     def reset(self):
         self.close()
@@ -78,6 +109,11 @@ class MXRecordIO:
 
     def write(self, buf):
         assert self.writable
+        if self.handle is not None:
+            from ._native import check_call
+            check_call(self._native.MXTRecordIOWriterWriteRecord(
+                self.handle, bytes(buf), len(buf)))
+            return
         data = struct.pack("<II", _kMagic, len(buf))
         self.fid.write(data)
         self.fid.write(buf)
@@ -87,6 +123,39 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self.handle is not None:
+            from ._native import check_call
+            buf = ctypes.POINTER(ctypes.c_char)()
+            size = ctypes.c_size_t()
+            check_call(self._native.MXTRecordIOReaderReadRecord(
+                self.handle, ctypes.byref(buf), ctypes.byref(size)))
+            if not buf:
+                return None
+            return ctypes.string_at(buf, size.value)
+        # full dmlc framing: cflag upper 3 bits (0 whole, 1 first, 2 middle,
+        # 3 last); multi-chunk records re-join with the elided magic seam
+        first = self._read_chunk()
+        if first is None:
+            return None
+        cflag, buf = first
+        if cflag == 0:
+            return buf
+        if cflag != 1:
+            raise MXNetError("RecordIO: unexpected continuation chunk")
+        parts = [buf]
+        while True:
+            nxt = self._read_chunk()
+            if nxt is None:
+                raise MXNetError("RecordIO: truncated multi-chunk record")
+            f, part = nxt
+            parts.append(struct.pack("<I", _kMagic))
+            parts.append(part)
+            if f == 3:
+                return b"".join(parts)
+            if f != 2:
+                raise MXNetError("RecordIO: bad chunk flag in record")
+
+    def _read_chunk(self):
         head = self.fid.read(8)
         if len(head) < 8:
             return None
@@ -94,17 +163,32 @@ class MXRecordIO:
         if magic != _kMagic:
             raise MXNetError("Invalid RecordIO magic number")
         length = lrec & ((1 << 29) - 1)
+        cflag = lrec >> 29
         buf = self.fid.read(length)
         pad = (4 - (length % 4)) % 4
         if pad:
             self.fid.read(pad)
-        return buf
+        return cflag, buf
 
     def tell(self):
+        if self.handle is not None:
+            from ._native import check_call
+            pos = ctypes.c_size_t()
+            if self.writable:
+                check_call(self._native.MXTRecordIOWriterTell(
+                    self.handle, ctypes.byref(pos)))
+            else:
+                check_call(self._native.MXTRecordIOReaderTell(
+                    self.handle, ctypes.byref(pos)))
+            return pos.value
         return self.fid.tell()
 
     def seek(self, pos):
         assert not self.writable
+        if self.handle is not None:
+            from ._native import check_call
+            check_call(self._native.MXTRecordIOReaderSeek(self.handle, pos))
+            return
         self.fid.seek(pos)
 
 
@@ -136,8 +220,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        pos = self.idx[idx]
-        self.fid.seek(pos)
+        MXRecordIO.seek(self, self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -145,7 +228,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)
-        pos = self.fid.tell()
+        pos = self.tell()
         self.write(buf)
         self.keys.append(key)
         self.idx[key] = pos

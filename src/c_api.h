@@ -1,0 +1,146 @@
+/*!
+ * \file c_api.h
+ * \brief C ABI of the mxnet_tpu native runtime library (libmxtpu.so).
+ *
+ * Capability parity with the reference's C API conventions
+ * (reference include/mxnet/c_api.h): every entry point returns int
+ * (0 = success, nonzero = failure) and the message is retrieved with
+ * MXTGetLastError() (reference src/c_api/c_api_error.cc). Handles are
+ * opaque void pointers. Only the subset that makes sense host-side for
+ * a TPU framework is native: record IO, image decode, COCO masks,
+ * NDArray file serialization. Device compute stays in XLA/Pallas.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXTPU_DLL __attribute__((visibility("default")))
+
+/*! \brief thread-local message of the last error in this thread */
+MXTPU_DLL const char *MXTGetLastError(void);
+/*! \brief library version as major*10000 + minor*100 + patch */
+MXTPU_DLL int MXTGetVersion(int *out);
+
+/* ------------------------------------------------------------------ */
+/* RecordIO (reference: python/mxnet/recordio.py backed by dmlc-core   */
+/* recordio; format doc: docs/faq/recordio.md)                         */
+/* ------------------------------------------------------------------ */
+
+typedef void *RecordIOHandle;
+
+MXTPU_DLL int MXTRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+MXTPU_DLL int MXTRecordIOWriterFree(RecordIOHandle handle);
+MXTPU_DLL int MXTRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                           const char *buf, size_t size);
+MXTPU_DLL int MXTRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+
+MXTPU_DLL int MXTRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+MXTPU_DLL int MXTRecordIOReaderFree(RecordIOHandle handle);
+/*! \brief read next record; *out_size==0 and *out==NULL at EOF.
+ *  The buffer stays valid until the next call on this handle. */
+MXTPU_DLL int MXTRecordIOReaderReadRecord(RecordIOHandle handle,
+                                          const char **out, size_t *out_size);
+MXTPU_DLL int MXTRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+MXTPU_DLL int MXTRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+
+/* ------------------------------------------------------------------ */
+/* Image codec (reference: src/io/image_recordio.h + OpenCV imdecode; */
+/* here libjpeg/libpng backed)                                         */
+/* ------------------------------------------------------------------ */
+
+/*! \brief decode a JPEG/PNG buffer to HWC uint8.
+ * \param flag 1 = force 3-channel BGR-order-free RGB, 0 = grayscale,
+ *             -1 = keep source channels.
+ * Two-call protocol: pass out_data=NULL to query dims, then call again
+ * with a buffer of h*w*c bytes. */
+MXTPU_DLL int MXTImageDecode(const char *buf, size_t size, int flag,
+                             int *out_h, int *out_w, int *out_c,
+                             unsigned char *out_data);
+/*! \brief encode HWC uint8 RGB to JPEG. Two-call protocol: out_buf=NULL
+ *  queries an upper bound for *out_size, second call writes and sets the
+ *  actual size. */
+MXTPU_DLL int MXTImageEncodeJPEG(const unsigned char *data, int h, int w,
+                                 int c, int quality, char *out_buf,
+                                 size_t *out_size);
+/*! \brief bilinear resize HWC uint8 */
+MXTPU_DLL int MXTImageResize(const unsigned char *src, int sh, int sw, int c,
+                             unsigned char *dst, int dh, int dw);
+
+/* ------------------------------------------------------------------ */
+/* Threaded RecordIO image pipeline (reference:                        */
+/* src/io/iter_image_recordio_2.cc — N decode threads + double-buffer  */
+/* prefetch). Produces float32 NCHW batches + label vectors.           */
+/* ------------------------------------------------------------------ */
+
+typedef void *ImagePipelineHandle;
+
+/*!
+ * \brief create a threaded decode/augment/batch pipeline over a .rec file.
+ * \param rec_path RecordIO file of IRHeader-packed images
+ * \param batch batch size
+ * \param h,w,c output shape (images resized so the short edge >= resize
+ *        then center/random cropped to h x w)
+ * \param label_width number of label floats per example
+ * \param nthreads decoder threads
+ * \param shuffle 1 to shuffle record order each epoch
+ * \param rand_crop 1 for random crop position (else center crop)
+ * \param rand_mirror 1 for random horizontal flip
+ * \param resize short-edge resize target (0 = no resize)
+ * \param seed RNG seed
+ * \param mean/std per-channel normalization (NULL = none)
+ * \param part_index,num_parts distributed sharding of the record set
+ */
+MXTPU_DLL int MXTImagePipelineCreate(const char *rec_path, int batch, int h,
+                                     int w, int c, int label_width,
+                                     int nthreads, int shuffle, int rand_crop,
+                                     int rand_mirror, int resize,
+                                     uint64_t seed, const float *mean,
+                                     const float *std, int part_index,
+                                     int num_parts, ImagePipelineHandle *out);
+MXTPU_DLL int MXTImagePipelineFree(ImagePipelineHandle handle);
+/*! \brief blocking next batch; fills data (batch*c*h*w floats) and label
+ *  (batch*label_width floats). *out_pad = #examples short of a full final
+ *  batch. Returns 0 and sets *out_eof=1 at epoch end. */
+MXTPU_DLL int MXTImagePipelineNext(ImagePipelineHandle handle, float *data,
+                                   float *label, int *out_pad, int *out_eof);
+MXTPU_DLL int MXTImagePipelineReset(ImagePipelineHandle handle);
+
+/* ------------------------------------------------------------------ */
+/* COCO RLE mask API (reference: src/coco_api/common/maskApi.h used by */
+/* src/operator/proposal_mask_target.cc)                               */
+/* ------------------------------------------------------------------ */
+
+/*! \brief encode binary masks (h*w*n, Fortran/column-major per COCO) to
+ *  counts; two-call protocol on out_counts (NULL queries *out_len). */
+MXTPU_DLL int MXTMaskEncode(const unsigned char *mask, int h, int w,
+                            uint32_t *out_counts, size_t *out_len);
+MXTPU_DLL int MXTMaskDecode(const uint32_t *counts, size_t n_counts, int h,
+                            int w, unsigned char *out_mask);
+MXTPU_DLL int MXTMaskArea(const uint32_t *counts, size_t n_counts,
+                          uint32_t *out_area);
+/*! \brief merge n RLE masks (concatenated counts, lens[i] each);
+ *  intersect != 0 -> AND else OR */
+MXTPU_DLL int MXTMaskMerge(const uint32_t *counts, const size_t *lens, int n,
+                           int h, int w, int intersect, uint32_t *out_counts,
+                           size_t *out_len);
+/*! \brief IoU between RLE masks a (na) and b (nb): out is na*nb row-major;
+ *  iscrowd (len nb, may be NULL) uses the crowd denominator */
+MXTPU_DLL int MXTMaskIoU(const uint32_t *a_counts, const size_t *a_lens,
+                         int na, const uint32_t *b_counts,
+                         const size_t *b_lens, int nb, int h, int w,
+                         const unsigned char *iscrowd, double *out);
+/*! \brief rasterize a polygon (xy pairs) to RLE */
+MXTPU_DLL int MXTMaskFrPoly(const double *xy, size_t k, int h, int w,
+                            uint32_t *out_counts, size_t *out_len);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

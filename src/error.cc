@@ -1,0 +1,20 @@
+#include "error.h"
+#include "c_api.h"
+
+namespace mxtpu {
+
+namespace {
+thread_local std::string last_error_;
+}
+
+void SetLastError(const std::string &msg) { last_error_ = msg; }
+const char *GetLastError() { return last_error_.c_str(); }
+
+}  // namespace mxtpu
+
+const char *MXTGetLastError(void) { return mxtpu::GetLastError(); }
+
+int MXTGetVersion(int *out) {
+  *out = 10201;  // capability parity target: reference 1.2.1
+  return 0;
+}

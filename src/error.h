@@ -1,0 +1,30 @@
+#ifndef MXTPU_ERROR_H_
+#define MXTPU_ERROR_H_
+
+#include <stdexcept>
+#include <string>
+
+namespace mxtpu {
+
+/*! \brief set the thread-local last-error message (reference convention:
+ *  src/c_api/c_api_error.cc MXAPISetLastError) */
+void SetLastError(const std::string &msg);
+const char *GetLastError();
+
+}  // namespace mxtpu
+
+/*! \brief wrap a C API body: catch exceptions -> -1 + last error */
+#define MXT_API_BEGIN() try {
+#define MXT_API_END()                                  \
+  }                                                    \
+  catch (const std::exception &e) {                    \
+    mxtpu::SetLastError(e.what());                     \
+    return -1;                                         \
+  }                                                    \
+  catch (...) {                                        \
+    mxtpu::SetLastError("unknown native error");       \
+    return -1;                                         \
+  }                                                    \
+  return 0;
+
+#endif  // MXTPU_ERROR_H_

@@ -1,0 +1,464 @@
+/*!
+ * \file image_pipeline.cc
+ * \brief Threaded RecordIO image decode/augment/batch pipeline.
+ *
+ * TPU-native equivalent of the reference's ImageRecordIter internals
+ * (src/io/iter_image_recordio_2.cc: ImageRecordIOParser2 decode threads
+ * + dmlc::ThreadedIter double-buffer prefetch, src/io/image_aug_default.cc
+ * augmentation). Host-side only: the GIL is never held; Python receives
+ * ready float32 NCHW batches it hands straight to the device.
+ *
+ * Threading model: a persistent decoder pool (N threads) fed per-example
+ * tasks by a coordinator thread that walks the (optionally shuffled,
+ * part_index/num_parts-sharded) record order; finished batches go into a
+ * bounded output queue (depth 3) consumed by MXTImagePipelineNext.
+ * Records are read with pread(2) at indexed offsets, so decoder threads
+ * never contend on a shared file position.
+ */
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "c_api.h"
+#include "error.h"
+
+namespace mxtpu {
+
+// from image_codec.cc
+void DecodeImage(const unsigned char *buf, size_t size, int flag,
+                 std::vector<unsigned char> *out, int *h, int *w, int *c);
+void BilinearResize(const unsigned char *src, int sh, int sw, int c,
+                    unsigned char *dst, int dh, int dw);
+
+static const uint32_t kMagic = 0xced7230a;
+
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+} __attribute__((packed));
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) {
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this] { Loop(); });
+  }
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto &t : workers_) t.join();
+  }
+  void Enqueue(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tasks_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void Loop() {
+    while (true) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        fn = std::move(tasks_.front());
+        tasks_.pop_front();
+      }
+      fn();
+    }
+  }
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> tasks_;
+  std::vector<std::thread> workers_;
+  bool stop_ = false;
+};
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> label;
+  int pad = 0;
+  bool eof = false;
+};
+
+class ImagePipeline {
+ public:
+  ImagePipeline(const std::string &rec_path, int batch, int h, int w, int c,
+                int label_width, int nthreads, bool shuffle, bool rand_crop,
+                bool rand_mirror, int resize, uint64_t seed, const float *mean,
+                const float *std, int part_index, int num_parts)
+      : batch_(batch), h_(h), w_(w), c_(c), label_width_(label_width),
+        shuffle_(shuffle), rand_crop_(rand_crop), rand_mirror_(rand_mirror),
+        resize_(resize), seed_(seed), pool_(nthreads > 0 ? nthreads : 1) {
+    if (mean) mean_.assign(mean, mean + c);
+    if (std) std_.assign(std, std + c);
+    fd_ = ::open(rec_path.c_str(), O_RDONLY);
+    if (fd_ < 0)
+      throw std::runtime_error("cannot open record file: " + rec_path);
+    IndexOffsets();
+    // distributed shard: contiguous slice, reference semantics of
+    // part_index/num_parts on ImageRecordIter
+    if (num_parts > 1) {
+      size_t n = offsets_.size();
+      size_t begin = n * part_index / num_parts;
+      size_t end = n * (part_index + 1) / num_parts;
+      offsets_.assign(offsets_.begin() + begin, offsets_.begin() + end);
+    }
+    if (offsets_.empty())
+      throw std::runtime_error("record file has no records: " + rec_path);
+    coordinator_ = std::thread([this] { Coordinate(); });
+  }
+
+  ~ImagePipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      reset_requested_ = true;
+    }
+    out_cv_.notify_all();
+    state_cv_.notify_all();
+    coordinator_.join();
+    ::close(fd_);
+  }
+
+  bool Next(float *data, float *label, int *pad, int *eof) {
+    std::unique_lock<std::mutex> lk(mu_);
+    out_cv_.wait(lk, [this] { return stop_ || !out_.empty(); });
+    if (stop_) return false;
+    Batch b = std::move(out_.front());
+    out_.pop_front();
+    lk.unlock();
+    state_cv_.notify_all();  // free a producer slot
+    if (b.eof) {
+      *eof = 1;
+      *pad = 0;
+      return true;
+    }
+    std::memcpy(data, b.data.data(), b.data.size() * sizeof(float));
+    std::memcpy(label, b.label.data(), b.label.size() * sizeof(float));
+    *pad = b.pad;
+    *eof = 0;
+    return true;
+  }
+
+  void Reset() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      reset_requested_ = true;
+      out_.clear();
+    }
+    state_cv_.notify_all();
+    out_cv_.notify_all();
+  }
+
+ private:
+  void IndexOffsets() {
+    // single sequential scan of chunk headers; logical records start at
+    // chunks with cflag 0 or 1
+    size_t pos = 0;
+    while (true) {
+      uint32_t head[2];
+      ssize_t n = ::pread(fd_, head, 8, pos);
+      if (n == 0) break;
+      if (n != 8) throw std::runtime_error("recordio: truncated header");
+      if (head[0] != kMagic)
+        throw std::runtime_error("recordio: bad magic while indexing");
+      uint32_t cflag = head[1] >> 29U;
+      uint32_t len = head[1] & ((1U << 29) - 1U);
+      if (cflag == 0 || cflag == 1) offsets_.push_back(pos);
+      pos += 8 + ((len + 3) & ~3U);
+    }
+  }
+
+  // read one logical (possibly multi-chunk) record at offset
+  std::vector<unsigned char> ReadRecord(size_t pos) const {
+    std::vector<unsigned char> out;
+    bool first = true;
+    while (true) {
+      uint32_t head[2];
+      if (::pread(fd_, head, 8, pos) != 8)
+        throw std::runtime_error("recordio: truncated record");
+      if (head[0] != kMagic) throw std::runtime_error("recordio: bad magic");
+      uint32_t cflag = head[1] >> 29U;
+      uint32_t len = head[1] & ((1U << 29) - 1U);
+      if (!first) {
+        const unsigned char *m =
+            reinterpret_cast<const unsigned char *>(&kMagic);
+        out.insert(out.end(), m, m + 4);  // re-insert elided seam
+      }
+      size_t old = out.size();
+      out.resize(old + len);
+      if (len &&
+          ::pread(fd_, out.data() + old, len, pos + 8) !=
+              static_cast<ssize_t>(len))
+        throw std::runtime_error("recordio: truncated payload");
+      pos += 8 + ((len + 3) & ~3U);
+      if (cflag == 0 || cflag == 3) return out;
+      first = false;
+    }
+  }
+
+  void DecodeOne(size_t offset, uint64_t rng_seed, float *data_out,
+                 float *label_out) const {
+    std::vector<unsigned char> rec = ReadRecord(offset);
+    if (rec.size() < sizeof(IRHeader))
+      throw std::runtime_error("record smaller than IRHeader");
+    IRHeader header;
+    std::memcpy(&header, rec.data(), sizeof(IRHeader));
+    const unsigned char *payload = rec.data() + sizeof(IRHeader);
+    size_t payload_size = rec.size() - sizeof(IRHeader);
+    // variable-width labels ride between header and image bytes
+    std::fill(label_out, label_out + label_width_, 0.0f);
+    if (header.flag > 0) {
+      size_t nlab = header.flag;
+      if (payload_size < nlab * 4)
+        throw std::runtime_error("record label array truncated");
+      size_t take = nlab < static_cast<size_t>(label_width_)
+                        ? nlab
+                        : static_cast<size_t>(label_width_);
+      std::memcpy(label_out, payload, take * sizeof(float));
+      payload += nlab * 4;
+      payload_size -= nlab * 4;
+    } else {
+      label_out[0] = header.label;
+    }
+
+    std::vector<unsigned char> img;
+    int sh, sw, sc;
+    DecodeImage(payload, payload_size, c_ == 1 ? 0 : 1, &img, &sh, &sw, &sc);
+    if (sc != c_)
+      throw std::runtime_error("decoded channel count mismatch");
+
+    // short-edge resize, then ensure the crop fits
+    std::vector<unsigned char> resized;
+    if (resize_ > 0) {
+      int short_edge = sh < sw ? sh : sw;
+      if (short_edge != resize_) {
+        float scale = static_cast<float>(resize_) / short_edge;
+        int nh = static_cast<int>(sh * scale + 0.5f);
+        int nw = static_cast<int>(sw * scale + 0.5f);
+        if (nh < h_) nh = h_;
+        if (nw < w_) nw = w_;
+        resized.resize(static_cast<size_t>(nh) * nw * c_);
+        BilinearResize(img.data(), sh, sw, c_, resized.data(), nh, nw);
+        img.swap(resized);
+        sh = nh;
+        sw = nw;
+      }
+    }
+    if (sh < h_ || sw < w_) {
+      float scale_h = static_cast<float>(h_) / sh;
+      float scale_w = static_cast<float>(w_) / sw;
+      float scale = scale_h > scale_w ? scale_h : scale_w;
+      int nh = static_cast<int>(sh * scale + 0.5f);
+      int nw = static_cast<int>(sw * scale + 0.5f);
+      if (nh < h_) nh = h_;
+      if (nw < w_) nw = w_;
+      resized.resize(static_cast<size_t>(nh) * nw * c_);
+      BilinearResize(img.data(), sh, sw, c_, resized.data(), nh, nw);
+      img.swap(resized);
+      sh = nh;
+      sw = nw;
+    }
+
+    std::mt19937_64 rng(rng_seed);
+    int y0, x0;
+    if (rand_crop_) {
+      y0 = sh == h_ ? 0 : static_cast<int>(rng() % (sh - h_ + 1));
+      x0 = sw == w_ ? 0 : static_cast<int>(rng() % (sw - w_ + 1));
+    } else {
+      y0 = (sh - h_) / 2;
+      x0 = (sw - w_) / 2;
+    }
+    bool mirror = rand_mirror_ && (rng() & 1) != 0;
+
+    // HWC crop -> normalized CHW float
+    for (int k = 0; k < c_; ++k) {
+      float m = mean_.empty() ? 0.0f : mean_[k];
+      float s = std_.empty() ? 1.0f : std_[k];
+      float inv = 1.0f / s;
+      float *plane = data_out + static_cast<size_t>(k) * h_ * w_;
+      for (int y = 0; y < h_; ++y) {
+        const unsigned char *row =
+            img.data() + ((static_cast<size_t>(y0 + y) * sw) + x0) * c_ + k;
+        float *orow = plane + static_cast<size_t>(y) * w_;
+        if (!mirror) {
+          for (int x = 0; x < w_; ++x)
+            orow[x] = (row[static_cast<size_t>(x) * c_] - m) * inv;
+        } else {
+          for (int x = 0; x < w_; ++x)
+            orow[x] = (row[static_cast<size_t>(w_ - 1 - x) * c_] - m) * inv;
+        }
+      }
+    }
+  }
+
+  void Coordinate() {
+    uint64_t epoch = 0;
+    std::vector<size_t> order(offsets_.size());
+    while (true) {
+      for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+      if (shuffle_) {
+        std::mt19937_64 rng(seed_ + epoch);
+        std::shuffle(order.begin(), order.end(), rng);
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        reset_requested_ = false;
+      }
+      size_t n = order.size();
+      size_t num_batches = (n + batch_ - 1) / batch_;
+      bool aborted = false;
+      for (size_t b = 0; b < num_batches && !aborted; ++b) {
+        Batch out;
+        out.data.resize(static_cast<size_t>(batch_) * c_ * h_ * w_);
+        out.label.resize(static_cast<size_t>(batch_) * label_width_);
+        std::atomic<int> remaining(batch_);
+        std::atomic<bool> failed(false);
+        std::string fail_msg;
+        std::mutex fail_mu;
+        std::mutex done_mu;
+        std::condition_variable done_cv;
+        for (int i = 0; i < batch_; ++i) {
+          size_t pos = b * batch_ + i;
+          // final partial batch wraps to the epoch start (reference
+          // round_batch semantics); pad reports the wrapped count
+          size_t idx = order[pos < n ? pos : pos % n];
+          if (pos >= n) out.pad++;
+          size_t offset = offsets_[idx];
+          float *dslot = out.data.data() + static_cast<size_t>(i) * c_ * h_ * w_;
+          float *lslot = out.label.data() + static_cast<size_t>(i) * label_width_;
+          uint64_t rs = seed_ ^ (epoch * 0x9E3779B97F4A7C15ULL) ^
+                        (pos * 0xBF58476D1CE4E5B9ULL);
+          pool_.Enqueue([this, offset, rs, dslot, lslot, &remaining, &failed,
+                         &fail_msg, &fail_mu, &done_mu, &done_cv] {
+            try {
+              DecodeOne(offset, rs, dslot, lslot);
+            } catch (const std::exception &e) {
+              std::lock_guard<std::mutex> lk(fail_mu);
+              failed = true;
+              fail_msg = e.what();
+            }
+            if (remaining.fetch_sub(1) == 1) {
+              std::lock_guard<std::mutex> lk(done_mu);
+              done_cv.notify_all();
+            }
+          });
+        }
+        {
+          std::unique_lock<std::mutex> lk(done_mu);
+          done_cv.wait(lk, [&] { return remaining.load() == 0; });
+        }
+        if (failed) {
+          // surface decode errors at the next Next() call
+          std::lock_guard<std::mutex> lk(mu_);
+          error_ = fail_msg;
+          stop_ = true;
+          out_cv_.notify_all();
+          return;
+        }
+        // bounded output queue: depth 3 (double-buffer + in-flight)
+        std::unique_lock<std::mutex> lk(mu_);
+        state_cv_.wait(lk, [this] {
+          return stop_ || reset_requested_ || out_.size() < 3;
+        });
+        if (stop_) return;
+        if (reset_requested_) {
+          aborted = true;
+          break;
+        }
+        out_.push_back(std::move(out));
+        out_cv_.notify_one();
+      }
+      if (!aborted) {
+        Batch eof;
+        eof.eof = true;
+        std::unique_lock<std::mutex> lk(mu_);
+        out_.push_back(std::move(eof));
+        out_cv_.notify_one();
+        // wait for Reset() (new epoch) or teardown
+        state_cv_.wait(lk, [this] { return stop_ || reset_requested_; });
+        if (stop_) return;
+      }
+      epoch++;
+    }
+  }
+
+ public:
+  std::string error_;
+
+ private:
+  int batch_, h_, w_, c_, label_width_;
+  bool shuffle_, rand_crop_, rand_mirror_;
+  int resize_;
+  uint64_t seed_;
+  std::vector<float> mean_, std_;
+  int fd_;
+  std::vector<size_t> offsets_;
+  ThreadPool pool_;
+  std::thread coordinator_;
+  std::mutex mu_;
+  std::condition_variable out_cv_, state_cv_;
+  std::deque<Batch> out_;
+  bool stop_ = false;
+  bool reset_requested_ = false;
+};
+
+}  // namespace mxtpu
+
+using mxtpu::ImagePipeline;
+
+int MXTImagePipelineCreate(const char *rec_path, int batch, int h, int w,
+                           int c, int label_width, int nthreads, int shuffle,
+                           int rand_crop, int rand_mirror, int resize,
+                           uint64_t seed, const float *mean, const float *std,
+                           int part_index, int num_parts,
+                           ImagePipelineHandle *out) {
+  MXT_API_BEGIN();
+  *out = new ImagePipeline(rec_path, batch, h, w, c, label_width, nthreads,
+                           shuffle != 0, rand_crop != 0, rand_mirror != 0,
+                           resize, seed, mean, std, part_index, num_parts);
+  MXT_API_END();
+}
+
+int MXTImagePipelineFree(ImagePipelineHandle handle) {
+  MXT_API_BEGIN();
+  delete static_cast<ImagePipeline *>(handle);
+  MXT_API_END();
+}
+
+int MXTImagePipelineNext(ImagePipelineHandle handle, float *data, float *label,
+                         int *out_pad, int *out_eof) {
+  MXT_API_BEGIN();
+  ImagePipeline *p = static_cast<ImagePipeline *>(handle);
+  if (!p->Next(data, label, out_pad, out_eof)) {
+    throw std::runtime_error(p->error_.empty() ? "pipeline stopped"
+                                               : p->error_);
+  }
+  MXT_API_END();
+}
+
+int MXTImagePipelineReset(ImagePipelineHandle handle) {
+  MXT_API_BEGIN();
+  static_cast<ImagePipeline *>(handle)->Reset();
+  MXT_API_END();
+}

@@ -1,0 +1,236 @@
+/*!
+ * \file mask_api.cc
+ * \brief COCO-style RLE mask utilities.
+ *
+ * Clean-room equivalent of the reference's vendored COCO mask API
+ * (src/coco_api/common/maskApi.h — encode/decode/merge/area/iou/frPoly),
+ * which backs the fork's proposal_mask_target op
+ * (src/operator/proposal_mask_target.cc). RLE convention matches COCO:
+ * column-major (Fortran) pixel order, counts alternate runs of 0s and 1s
+ * starting with zeros. Polygon rasterization uses even-odd scanline fill
+ * sampled at pixel centers (behaviorally equivalent for box-scale masks;
+ * COCO's 5x-upsampled boundary trace differs at most on boundary pixels).
+ */
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+#include <vector>
+
+#include "c_api.h"
+#include "error.h"
+
+namespace mxtpu {
+
+using RLE = std::vector<uint32_t>;
+
+static RLE RleEncode(const unsigned char *mask, int h, int w) {
+  RLE counts;
+  size_t n = static_cast<size_t>(h) * w;
+  uint32_t run = 0;
+  unsigned char cur = 0;  // first run counts zeros
+  for (size_t i = 0; i < n; ++i) {
+    unsigned char v = mask[i] ? 1 : 0;
+    if (v == cur) {
+      ++run;
+    } else {
+      counts.push_back(run);
+      cur = v;
+      run = 1;
+    }
+  }
+  counts.push_back(run);
+  return counts;
+}
+
+static void RleDecode(const uint32_t *counts, size_t n, int h, int w,
+                      unsigned char *mask) {
+  size_t total = static_cast<size_t>(h) * w;
+  size_t pos = 0;
+  unsigned char v = 0;
+  for (size_t i = 0; i < n; ++i) {
+    uint32_t c = counts[i];
+    if (pos + c > total) throw std::runtime_error("RLE longer than mask");
+    std::memset(mask + pos, v, c);
+    pos += c;
+    v = 1 - v;
+  }
+  if (pos != total) throw std::runtime_error("RLE shorter than mask");
+}
+
+static uint64_t RleArea(const uint32_t *counts, size_t n) {
+  uint64_t a = 0;
+  for (size_t i = 1; i < n; i += 2) a += counts[i];
+  return a;
+}
+
+// intersection area via interval walk over the linear (column-major) index
+static uint64_t RleIntersection(const uint32_t *a, size_t na,
+                                const uint32_t *b, size_t nb) {
+  uint64_t inter = 0;
+  size_t ia = 0, ib = 0;
+  uint64_t ca = ia < na ? a[ia] : 0;  // end of current a-run
+  uint64_t cb = ib < nb ? b[ib] : 0;
+  uint64_t pa = 0, pb = 0;  // start of current run
+  bool va = false, vb = false;
+  while (ia < na && ib < nb) {
+    if (va && vb) {
+      uint64_t lo = std::max(pa, pb);
+      uint64_t hi = std::min(ca, cb);
+      if (hi > lo) inter += hi - lo;
+    }
+    if (ca <= cb) {
+      ++ia;
+      va = !va;
+      pa = ca;
+      if (ia < na) ca += a[ia];
+    } else {
+      ++ib;
+      vb = !vb;
+      pb = cb;
+      if (ib < nb) cb += b[ib];
+    }
+  }
+  return inter;
+}
+
+// even-odd scanline polygon fill, column-major output
+static void FillPoly(const double *xy, size_t k, int h, int w,
+                     unsigned char *mask) {
+  std::memset(mask, 0, static_cast<size_t>(h) * w);
+  if (k < 3) return;
+  for (int y = 0; y < h; ++y) {
+    double yc = y + 0.5;
+    std::vector<double> xs;
+    for (size_t i = 0; i < k; ++i) {
+      size_t j = (i + 1) % k;
+      double y0 = xy[2 * i + 1], y1 = xy[2 * j + 1];
+      double x0 = xy[2 * i], x1 = xy[2 * j];
+      if ((y0 <= yc && y1 > yc) || (y1 <= yc && y0 > yc)) {
+        double t = (yc - y0) / (y1 - y0);
+        xs.push_back(x0 + t * (x1 - x0));
+      }
+    }
+    std::sort(xs.begin(), xs.end());
+    for (size_t i = 0; i + 1 < xs.size(); i += 2) {
+      int x_lo = static_cast<int>(std::ceil(xs[i] - 0.5));
+      int x_hi = static_cast<int>(std::floor(xs[i + 1] - 0.5));
+      if (x_lo < 0) x_lo = 0;
+      if (x_hi >= w) x_hi = w - 1;
+      for (int x = x_lo; x <= x_hi; ++x)
+        mask[static_cast<size_t>(x) * h + y] = 1;
+    }
+  }
+}
+
+}  // namespace mxtpu
+
+int MXTMaskEncode(const unsigned char *mask, int h, int w,
+                  uint32_t *out_counts, size_t *out_len) {
+  MXT_API_BEGIN();
+  mxtpu::RLE r = mxtpu::RleEncode(mask, h, w);
+  if (out_counts == nullptr) {
+    *out_len = r.size();
+    return 0;
+  }
+  if (r.size() > *out_len)
+    throw std::runtime_error("mask encode: output buffer too small");
+  std::memcpy(out_counts, r.data(), r.size() * sizeof(uint32_t));
+  *out_len = r.size();
+  MXT_API_END();
+}
+
+int MXTMaskDecode(const uint32_t *counts, size_t n_counts, int h, int w,
+                  unsigned char *out_mask) {
+  MXT_API_BEGIN();
+  mxtpu::RleDecode(counts, n_counts, h, w, out_mask);
+  MXT_API_END();
+}
+
+int MXTMaskArea(const uint32_t *counts, size_t n_counts, uint32_t *out_area) {
+  MXT_API_BEGIN();
+  *out_area = static_cast<uint32_t>(mxtpu::RleArea(counts, n_counts));
+  MXT_API_END();
+}
+
+int MXTMaskMerge(const uint32_t *counts, const size_t *lens, int n, int h,
+                 int w, int intersect, uint32_t *out_counts, size_t *out_len) {
+  MXT_API_BEGIN();
+  size_t total = static_cast<size_t>(h) * w;
+  std::vector<unsigned char> acc(total, intersect ? 1 : 0);
+  std::vector<unsigned char> cur(total);
+  const uint32_t *p = counts;
+  for (int i = 0; i < n; ++i) {
+    mxtpu::RleDecode(p, lens[i], h, w, cur.data());
+    p += lens[i];
+    if (intersect) {
+      for (size_t j = 0; j < total; ++j) acc[j] &= cur[j];
+    } else {
+      for (size_t j = 0; j < total; ++j) acc[j] |= cur[j];
+    }
+  }
+  mxtpu::RLE r = mxtpu::RleEncode(acc.data(), h, w);
+  if (out_counts == nullptr) {
+    *out_len = r.size();
+    return 0;
+  }
+  if (r.size() > *out_len)
+    throw std::runtime_error("mask merge: output buffer too small");
+  std::memcpy(out_counts, r.data(), r.size() * sizeof(uint32_t));
+  *out_len = r.size();
+  MXT_API_END();
+}
+
+int MXTMaskIoU(const uint32_t *a_counts, const size_t *a_lens, int na,
+               const uint32_t *b_counts, const size_t *b_lens, int nb, int h,
+               int w, const unsigned char *iscrowd, double *out) {
+  MXT_API_BEGIN();
+  (void)h;
+  (void)w;
+  std::vector<const uint32_t *> ap(na), bp(nb);
+  {
+    const uint32_t *p = a_counts;
+    for (int i = 0; i < na; ++i) {
+      ap[i] = p;
+      p += a_lens[i];
+    }
+    p = b_counts;
+    for (int j = 0; j < nb; ++j) {
+      bp[j] = p;
+      p += b_lens[j];
+    }
+  }
+  for (int i = 0; i < na; ++i) {
+    uint64_t area_a = mxtpu::RleArea(ap[i], a_lens[i]);
+    for (int j = 0; j < nb; ++j) {
+      uint64_t area_b = mxtpu::RleArea(bp[j], b_lens[j]);
+      uint64_t inter =
+          mxtpu::RleIntersection(ap[i], a_lens[i], bp[j], b_lens[j]);
+      // iscrowd ground truth uses the detection area as denominator
+      // (COCO convention)
+      double denom = (iscrowd && iscrowd[j])
+                         ? static_cast<double>(area_a)
+                         : static_cast<double>(area_a + area_b - inter);
+      out[static_cast<size_t>(i) * nb + j] =
+          denom > 0 ? static_cast<double>(inter) / denom : 0.0;
+    }
+  }
+  MXT_API_END();
+}
+
+int MXTMaskFrPoly(const double *xy, size_t k, int h, int w,
+                  uint32_t *out_counts, size_t *out_len) {
+  MXT_API_BEGIN();
+  std::vector<unsigned char> mask(static_cast<size_t>(h) * w);
+  mxtpu::FillPoly(xy, k, h, w, mask.data());
+  mxtpu::RLE r = mxtpu::RleEncode(mask.data(), h, w);
+  if (out_counts == nullptr) {
+    *out_len = r.size();
+    return 0;
+  }
+  if (r.size() > *out_len)
+    throw std::runtime_error("frPoly: output buffer too small");
+  std::memcpy(out_counts, r.data(), r.size() * sizeof(uint32_t));
+  *out_len = r.size();
+  MXT_API_END();
+}

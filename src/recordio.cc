@@ -1,0 +1,223 @@
+/*!
+ * \file recordio.cc
+ * \brief Native RecordIO reader/writer.
+ *
+ * Clean-room implementation of the record framing used by the reference
+ * (dmlc-core recordio, consumed via python/mxnet/recordio.py and
+ * src/io/iter_image_recordio_2.cc; format described in
+ * docs/faq/recordio.md): each record is one or more chunks of
+ *
+ *   [kMagic : u32][lrecord : u32][payload][pad to 4B]
+ *
+ * where lrecord packs cflag (upper 3 bits) | length (lower 29 bits).
+ * Payloads that themselves contain the magic word at a 4-byte-aligned
+ * offset are split there (the in-payload magic is elided on write and
+ * re-inserted on read), with cflag 0 = whole record, 1 = first chunk,
+ * 2 = middle, 3 = last. This keeps files resynchronizable after
+ * corruption while remaining binary-compatible with simple
+ * single-chunk readers for magic-free payloads.
+ */
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "error.h"
+
+namespace mxtpu {
+
+static const uint32_t kMagic = 0xced7230a;
+static const uint32_t kLenMask = (1U << 29) - 1U;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t len) {
+  return (cflag << 29U) | len;
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return rec >> 29U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & kLenMask; }
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const char *uri) {
+    fp_ = std::fopen(uri, "wb");
+    if (fp_ == nullptr)
+      throw std::runtime_error(std::string("cannot open for write: ") + uri);
+  }
+  ~RecordIOWriter() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  void WriteRecord(const char *buf, size_t size) {
+    if (size >= (1ULL << 29))
+      throw std::runtime_error("RecordIO record too large (>=2^29 bytes)");
+    // find 4-byte-aligned magic occurrences; split the payload there
+    size_t lower = size & ~size_t(3);
+    size_t seg_begin = 0;
+    std::vector<std::pair<size_t, size_t>> segs;  // (begin, len)
+    for (size_t i = 0; i + 4 <= lower; i += 4) {
+      uint32_t w;
+      std::memcpy(&w, buf + i, 4);
+      if (w == kMagic) {
+        segs.emplace_back(seg_begin, i - seg_begin);
+        seg_begin = i + 4;  // elide the magic word itself
+      }
+    }
+    segs.emplace_back(seg_begin, size - seg_begin);
+    for (size_t i = 0; i < segs.size(); ++i) {
+      uint32_t cflag;
+      if (segs.size() == 1) cflag = 0;
+      else if (i == 0) cflag = 1;
+      else if (i + 1 == segs.size()) cflag = 3;
+      else cflag = 2;
+      WriteChunk(cflag, buf + segs[i].first, segs[i].second);
+    }
+  }
+
+  size_t Tell() { return static_cast<size_t>(std::ftell(fp_)); }
+
+ private:
+  void WriteChunk(uint32_t cflag, const char *data, size_t len) {
+    uint32_t head[2] = {kMagic, EncodeLRec(cflag, static_cast<uint32_t>(len))};
+    if (std::fwrite(head, 1, 8, fp_) != 8)
+      throw std::runtime_error("RecordIO write failed");
+    if (len && std::fwrite(data, 1, len, fp_) != len)
+      throw std::runtime_error("RecordIO write failed");
+    size_t pad = (4 - (len & 3)) & 3;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad && std::fwrite(zeros, 1, pad, fp_) != pad)
+      throw std::runtime_error("RecordIO write failed");
+  }
+  std::FILE *fp_;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const char *uri) {
+    fp_ = std::fopen(uri, "rb");
+    if (fp_ == nullptr)
+      throw std::runtime_error(std::string("cannot open for read: ") + uri);
+  }
+  ~RecordIOReader() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  /*! \brief read the next logical record; false at EOF */
+  bool ReadRecord(std::string *out) {
+    out->clear();
+    uint32_t cflag;
+    if (!ReadChunk(&cflag, out)) return false;
+    if (cflag == 0) return true;
+    if (cflag != 1)
+      throw std::runtime_error("RecordIO: unexpected continuation chunk");
+    while (true) {
+      std::string part;
+      uint32_t f;
+      if (!ReadChunk(&f, &part))
+        throw std::runtime_error("RecordIO: truncated multi-chunk record");
+      // re-insert the elided magic seam
+      const char *m = reinterpret_cast<const char *>(&kMagic);
+      out->append(m, 4);
+      out->append(part);
+      if (f == 3) return true;
+      if (f != 2)
+        throw std::runtime_error("RecordIO: bad chunk flag in record");
+    }
+  }
+
+  void Seek(size_t pos) {
+    if (std::fseek(fp_, static_cast<long>(pos), SEEK_SET) != 0)
+      throw std::runtime_error("RecordIO seek failed");
+  }
+  size_t Tell() { return static_cast<size_t>(std::ftell(fp_)); }
+
+  std::string buffer;  // last record, exposed through the C API
+
+ private:
+  bool ReadChunk(uint32_t *cflag, std::string *out) {
+    uint32_t head[2];
+    size_t n = std::fread(head, 1, 8, fp_);
+    if (n == 0) return false;
+    if (n != 8) throw std::runtime_error("RecordIO: truncated header");
+    if (head[0] != kMagic)
+      throw std::runtime_error("RecordIO: invalid magic number");
+    uint32_t len = DecodeLength(head[1]);
+    *cflag = DecodeFlag(head[1]);
+    out->resize(len);
+    if (len && std::fread(&(*out)[0], 1, len, fp_) != len)
+      throw std::runtime_error("RecordIO: truncated payload");
+    size_t pad = (4 - (len & 3)) & 3;
+    char skip[4];
+    if (pad && std::fread(skip, 1, pad, fp_) != pad)
+      throw std::runtime_error("RecordIO: truncated padding");
+    return true;
+  }
+  std::FILE *fp_;
+};
+
+}  // namespace mxtpu
+
+using mxtpu::RecordIOReader;
+using mxtpu::RecordIOWriter;
+
+int MXTRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  MXT_API_BEGIN();
+  *out = new RecordIOWriter(uri);
+  MXT_API_END();
+}
+
+int MXTRecordIOWriterFree(RecordIOHandle handle) {
+  MXT_API_BEGIN();
+  delete static_cast<RecordIOWriter *>(handle);
+  MXT_API_END();
+}
+
+int MXTRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                 size_t size) {
+  MXT_API_BEGIN();
+  static_cast<RecordIOWriter *>(handle)->WriteRecord(buf, size);
+  MXT_API_END();
+}
+
+int MXTRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  MXT_API_BEGIN();
+  *pos = static_cast<RecordIOWriter *>(handle)->Tell();
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  MXT_API_BEGIN();
+  *out = new RecordIOReader(uri);
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderFree(RecordIOHandle handle) {
+  MXT_API_BEGIN();
+  delete static_cast<RecordIOReader *>(handle);
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderReadRecord(RecordIOHandle handle, const char **out,
+                                size_t *out_size) {
+  MXT_API_BEGIN();
+  RecordIOReader *r = static_cast<RecordIOReader *>(handle);
+  if (r->ReadRecord(&r->buffer)) {
+    *out = r->buffer.data();
+    *out_size = r->buffer.size();
+  } else {
+    *out = nullptr;
+    *out_size = 0;
+  }
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  MXT_API_BEGIN();
+  static_cast<RecordIOReader *>(handle)->Seek(pos);
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderTell(RecordIOHandle handle, size_t *pos) {
+  MXT_API_BEGIN();
+  *pos = static_cast<RecordIOReader *>(handle)->Tell();
+  MXT_API_END();
+}

@@ -1,0 +1,260 @@
+"""Native library (libmxtpu.so) tests: recordio framing, image codec,
+threaded pipeline, COCO masks.
+
+Mirrors the reference coverage of tests/python/unittest/test_recordio.py
+and the COCO mask semantics used by proposal_mask_target.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, coco_mask
+from mxnet_tpu._native import lib as native_lib
+
+HAVE_NATIVE = native_lib() is not None
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    records = [b"x" * n for n in (1, 3, 4, 5, 100, 0)]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for expect in records:
+        assert r.read() == expect
+    assert r.read() is None
+    r.close()
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_recordio_magic_payload(tmp_path):
+    # payloads containing the magic word exercise multi-chunk framing,
+    # which only the native path implements (dmlc recordio parity)
+    magic = (0xced7230a).to_bytes(4, "little")
+    payloads = [
+        magic,
+        b"abcd" + magic + b"efgh",
+        magic + magic + magic,
+        b"ab" + magic + b"cd",  # unaligned magic: must NOT split
+        b"abc" + magic * 2 + b"defg1234",
+    ]
+    path = str(tmp_path / "magic.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for expect in payloads:
+        assert r.read() == expect
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec_path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(20):
+        w.write_idx(i, b"record_%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    for i in [13, 2, 19, 0, 7]:
+        assert r.read_idx(i) == b"record_%d" % i
+    assert r.keys == list(range(20))
+    r.close()
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_image_codec_roundtrip():
+    from mxnet_tpu.image.codec import imencode, imdecode_np
+    img = np.zeros((32, 48, 3), np.uint8)
+    img[:16] = [255, 0, 0]     # BGR blue-ish block
+    img[16:] = [0, 255, 0]
+    buf = imencode(img, ".jpg", quality=95)
+    assert buf[:2] == b"\xff\xd8"
+    dec = imdecode_np(buf, iscolor=1)
+    assert dec.shape == (32, 48, 3)
+    # JPEG is lossy; block colors should survive approximately
+    assert np.abs(dec[:14].astype(int) - img[:14].astype(int)).mean() < 12
+    gray = imdecode_np(buf, iscolor=0)
+    assert gray.shape == (32, 48)
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_native_resize():
+    import ctypes
+    lib = native_lib()
+    src = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(16, 16, 3)
+    dst = np.empty((8, 8, 3), np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    assert lib.MXTImageResize(src.ctypes.data_as(u8p), 16, 16, 3,
+                              dst.ctypes.data_as(u8p), 8, 8) == 0
+    # downscale of a gradient stays a gradient
+    assert dst[0, 0, 0] < dst[7, 7, 0]
+
+
+def _make_rec(tmp_path, n=24, h=40, w=40):
+    from mxnet_tpu.image.codec import imencode
+    rec_path = str(tmp_path / "imgs.rec")
+    writer = recordio.MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i % 10), i, 0)
+        writer.write(recordio.pack(header, imencode(img, ".jpg")))
+    writer.close()
+    return rec_path
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_native_image_pipeline(tmp_path):
+    rec_path = _make_rec(tmp_path, n=24)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                               batch_size=8, shuffle=True, rand_crop=True,
+                               preprocess_threads=3, seed=7)
+    from mxnet_tpu.image.record_iter import NativeImageRecordIter
+    assert isinstance(it, NativeImageRecordIter)
+    seen = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 32, 32)
+        assert batch.label[0].shape == (8,)
+        labels.append(batch.label[0].asnumpy())
+        seen += 8 - batch.pad
+    assert seen == 24
+    # labels are the class ids we packed
+    all_labels = np.concatenate(labels)
+    assert set(all_labels.astype(int)) <= set(range(10))
+    # second epoch after reset
+    it.reset()
+    seen2 = sum(8 - b.pad for b in it)
+    assert seen2 == 24
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_native_pipeline_partial_batch_pad(tmp_path):
+    rec_path = _make_rec(tmp_path, n=10)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                               batch_size=8)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].pad == 0
+    assert batches[1].pad == 6  # 10 = 8 + 2, final batch wraps 6
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_native_pipeline_sticky_eof_and_tiny_shard(tmp_path):
+    # batch much larger than the record count exercises modulo wrap,
+    # and a second exhausted iteration must re-raise StopIteration
+    # instead of deadlocking on the native coordinator
+    rec_path = _make_rec(tmp_path, n=3)
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                               batch_size=8)
+    batches = list(it)
+    assert len(batches) == 1
+    assert batches[0].pad == 5
+    assert list(it) == []
+    it.reset()
+    assert len(list(it)) == 1
+
+
+def test_python_fallback_round_batch(tmp_path):
+    # fallback iterator must match native round_batch semantics
+    import mxnet_tpu._native as nat
+    from mxnet_tpu.image.record_iter import ImageRecordIterImpl
+    rec_path = _make_rec(tmp_path, n=10)
+    it = ImageRecordIterImpl(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                             batch_size=8)
+    batches = list(it)
+    assert [b.pad for b in batches] == [0, 6]
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_python_fallback_reads_native_multichunk(tmp_path):
+    # records containing the aligned magic are written multi-chunk by the
+    # native writer; the pure-Python reader must reassemble them
+    magic = (0xced7230a).to_bytes(4, "little")
+    payload = b"head" + magic + b"tail"
+    path = str(tmp_path / "mc.rec")
+    w = recordio.MXRecordIO(path, "w")
+    assert w.handle is not None
+    w.write(payload)
+    w.close()
+    import mxnet_tpu._native as nat
+    saved = nat._LIB
+    try:
+        nat._LIB = None
+        r = recordio.MXRecordIO(path, "r")
+        assert r.handle is None
+        assert r.read() == payload
+        assert r.read() is None
+        r.close()
+    finally:
+        nat._LIB = saved
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_native_pipeline_sharding(tmp_path):
+    rec_path = _make_rec(tmp_path, n=24)
+    counts = []
+    for part in range(3):
+        it = mx.io.ImageRecordIter(path_imgrec=rec_path,
+                                   data_shape=(3, 32, 32), batch_size=4,
+                                   part_index=part, num_parts=3)
+        counts.append(sum(4 - b.pad for b in it))
+    assert counts == [8, 8, 8]
+
+
+def test_mask_encode_decode_roundtrip():
+    rng = np.random.RandomState(3)
+    mask = (rng.rand(17, 23) > 0.5).astype(np.uint8)
+    rle = coco_mask.encode(mask)
+    assert rle["size"] == [17, 23]
+    back = coco_mask.decode(rle)
+    np.testing.assert_array_equal(mask, back)
+    assert coco_mask.area(rle) == int(mask.sum())
+
+
+def test_mask_merge_and_iou():
+    a = np.zeros((10, 10), np.uint8)
+    a[2:6, 2:6] = 1  # 16 px
+    b = np.zeros((10, 10), np.uint8)
+    b[4:8, 4:8] = 1  # 16 px, overlap 2x2=4
+    ra, rb = coco_mask.encode(a), coco_mask.encode(b)
+    union = coco_mask.merge([ra, rb])
+    inter = coco_mask.merge([ra, rb], intersect=True)
+    assert coco_mask.area(union) == 28
+    assert coco_mask.area(inter) == 4
+    got = coco_mask.iou([ra], [rb])
+    np.testing.assert_allclose(got, [[4.0 / 28.0]], rtol=1e-9)
+    crowd = coco_mask.iou([ra], [rb], iscrowd=[1])
+    np.testing.assert_allclose(crowd, [[4.0 / 16.0]], rtol=1e-9)
+
+
+def test_mask_frpoly():
+    # axis-aligned square covering pixel centers [2..6] x [2..6]
+    rle = coco_mask.frPoly([2, 2, 7, 2, 7, 7, 2, 7], 10, 10)
+    mask = coco_mask.decode(rle)
+    assert coco_mask.area(rle) == mask.sum()
+    assert mask.sum() == 25
+    assert mask[4, 4] == 1 and mask[0, 0] == 0
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native lib required")
+def test_mask_native_matches_numpy_fallback():
+    import mxnet_tpu._native as nat
+    rng = np.random.RandomState(11)
+    masks = (rng.rand(13, 9, 4) > 0.6).astype(np.uint8)
+    native_rles = coco_mask.encode(masks)
+    saved = nat._LIB
+    try:
+        nat._LIB = None  # force the pure-NumPy fallback
+        py_rles = coco_mask.encode(masks)
+        for nr, pr in zip(native_rles, py_rles):
+            np.testing.assert_array_equal(nr["counts"], pr["counts"])
+        np.testing.assert_array_equal(coco_mask.decode(py_rles), masks)
+    finally:
+        nat._LIB = saved
