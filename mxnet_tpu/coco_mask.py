@@ -158,7 +158,7 @@ def iou(dt, gt, iscrowd=None):
 
 def frPoly(polys, h, w):
     """Rasterize polygon(s) [x0,y0,x1,y1,...] to RLE(s)."""
-    single = polys and np.isscalar(polys[0])
+    single = len(polys) > 0 and np.ndim(polys[0]) == 0
     if single:
         polys = [polys]
     native = _lib()

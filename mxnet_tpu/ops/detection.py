@@ -718,3 +718,386 @@ def _farthest_point_sampling(params, xyz):
         return idx
 
     return (jax.vmap(one)(xyz).astype(jnp.int32),)
+
+
+# ---------------------------------------------------------------------------
+# Fork RCNN target ops: ProposalTarget / ProposalMaskTarget / PostDetection
+# ---------------------------------------------------------------------------
+
+def _masked_rank(key, mask):
+    """Rank of each element among ``mask`` members ordered by ``key`` asc;
+    non-members get rank N (past the end)."""
+    n = key.shape[0]
+    order = jnp.argsort(jnp.where(mask, key, jnp.inf))
+    pos = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return jnp.where(mask, pos, n)
+
+
+def _bbox_overlap_plus1(boxes, query):
+    """IoU with the reference's +1 pixel convention
+    (proposal_target.cc:166-186 BBoxOverlap)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    qx1, qy1, qx2, qy2 = query[:, 0], query[:, 1], query[:, 2], query[:, 3]
+    iw = (jnp.minimum(x2[:, None], qx2[None, :])
+          - jnp.maximum(x1[:, None], qx1[None, :]) + 1.0)
+    ih = (jnp.minimum(y2[:, None], qy2[None, :])
+          - jnp.maximum(y1[:, None], qy1[None, :]) + 1.0)
+    inter = jnp.maximum(iw, 0.0) * jnp.maximum(ih, 0.0)
+    area = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    qarea = (qx2 - qx1 + 1.0) * (qy2 - qy1 + 1.0)
+    return inter / (area[:, None] + qarea[None, :] - inter)
+
+
+def _bbox_transform_norm(ex, gt, mean, std):
+    """Regression targets (proposal_target.cc:206-229
+    NonLinearTransformAndNormalization)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1.0)
+    ecy = ex[:, 1] + 0.5 * (eh - 1.0)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    t = jnp.stack([(gcx - ecx) / (ew + 1e-14),
+                   (gcy - ecy) / (eh + 1e-14),
+                   jnp.log(gw / ew), jnp.log(gh / eh)], axis=1)
+    return (t - mean[None, :]) / std[None, :]
+
+
+def _sample_rois_one_image(key, rois_i, gt_i, img_idx, *, rois_per_image,
+                           fg_cap, num_classes, fg_thresh, bg_hi, bg_lo,
+                           without_gt, mean, std, weight):
+    """Fixed-shape ROI sampling for one image (proposal_target.cc:22-164
+    SampleROI). random_shuffle+resize becomes rank-by-random-key selection:
+    fg first, then bg, then negatives pad the remainder.
+
+    Returns (kept_rows(rois_per_image,5), labels, targets, weights,
+    kept_gt_assignment) — the assignment is reused by ProposalMaskTarget.
+    """
+    R = rois_i.shape[0]
+    G = gt_i.shape[0]
+    valid_gt = gt_i[:, 4] != -1
+    any_gt = jnp.any(valid_gt)
+
+    # candidate pool: the image's rois, then (optionally) its valid gt
+    # boxes re-laid-out as [img_idx, x1, y1, x2, y2]. (The reference
+    # appends the raw gt row — [x1,y1,x2,y2,cls] — leaving a stale class
+    # id in the batch-index slot; we append the sane roi layout.)
+    idx_col = jnp.broadcast_to(
+        jnp.asarray(img_idx, gt_i.dtype), (G, 1))
+    gt_as_roi = jnp.concatenate([idx_col, gt_i[:, :4]], axis=1)
+    cand = jnp.concatenate([rois_i, gt_as_roi], axis=0)      # (R+G, 5)
+    cand_valid = jnp.concatenate(
+        [jnp.ones((R,), bool),
+         valid_gt if not without_gt else jnp.zeros((G,), bool)])
+    N = R + G
+
+    ious = _bbox_overlap_plus1(cand[:, 1:5], gt_i[:, :4])    # (N, G)
+    ious = jnp.where(valid_gt[None, :], ious, -1.0)
+    assignment = jnp.argmax(ious, axis=1)
+    max_ov = jnp.where(any_gt, jnp.max(ious, axis=1), 0.0)
+    cand_label = jnp.where(any_gt, gt_i[assignment, 4], 0.0)
+
+    fg = cand_valid & (max_ov >= fg_thresh)
+    bg = cand_valid & (max_ov >= bg_lo) & (max_ov < bg_hi)
+    neg = cand_valid & ~fg
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    fg_rank = _masked_rank(jax.random.uniform(k1, (N,)), fg)
+    n_fg = jnp.minimum(jnp.sum(fg), fg_cap)
+    sel_fg = fg & (fg_rank < n_fg)
+    bg_rank = _masked_rank(jax.random.uniform(k2, (N,)), bg)
+    n_bg = jnp.minimum(jnp.sum(bg), rois_per_image - n_fg)
+    sel_bg = bg & (bg_rank < n_bg)
+    # pad the remainder from the negative pool (reference pads by an
+    # independent shuffle of neg_indexes, possibly duplicating a bg row;
+    # we select distinct rows instead)
+    pad_rank = _masked_rank(jax.random.uniform(k3, (N,)), neg & ~sel_bg)
+    sel_pad = (neg & ~sel_bg) & (pad_rank < rois_per_image - n_fg - n_bg)
+
+    cat = jnp.where(sel_fg, 0, jnp.where(sel_bg, 1, jnp.where(sel_pad, 2, 3)))
+    tie = jnp.where(sel_fg, fg_rank,
+                    jnp.where(sel_bg, bg_rank,
+                              jnp.where(sel_pad, pad_rank,
+                                        jnp.arange(N, dtype=jnp.int32))))
+    kept = jnp.argsort(cat * (N + 1) + tie)[:rois_per_image]
+
+    pos = jnp.arange(rois_per_image)
+    labels = jnp.where(pos < n_fg, cand_label[kept], 0.0)
+    kept_rows = cand[kept]
+
+    gt_assign_kept = assignment[kept]
+    gt_boxes_kept = jnp.where(any_gt, gt_i[gt_assign_kept, :4],
+                              jnp.zeros((rois_per_image, 4), gt_i.dtype))
+    t = _bbox_transform_norm(kept_rows[:, 1:5], gt_boxes_kept, mean, std)
+
+    # expand to per-class columns where label > 0
+    # (proposal_target.cc:188-204 ExpandBboxRegressionTargets)
+    cls = labels.astype(jnp.int32)
+    onehot = (jnp.arange(num_classes)[None, :] == cls[:, None]) \
+        & (cls > 0)[:, None]                                  # (P, C)
+    targets = (onehot[:, :, None] * t[:, None, :]).reshape(
+        rois_per_image, num_classes * 4)
+    weights = (onehot[:, :, None] * weight[None, None, :]).reshape(
+        rois_per_image, num_classes * 4)
+    return kept_rows, labels, targets, weights, gt_assign_kept, n_fg
+
+
+def _pt_params(params):
+    mean = jnp.asarray(_tuple_param(params, "bbox_mean",
+                                    (0.0, 0.0, 0.0, 0.0)), jnp.float32)
+    std = jnp.asarray(_tuple_param(params, "bbox_std",
+                                   (0.1, 0.1, 0.2, 0.2)), jnp.float32)
+    weight = jnp.asarray(_tuple_param(params, "bbox_weight",
+                                      (1.0, 1.0, 1.0, 1.0)), jnp.float32)
+    if params.get("ohem", False):
+        raise NotImplementedError("OHEM not implemented (reference "
+                                  "proposal_target-inl.h:133 raises too)")
+    return mean, std, weight
+
+
+@register("ProposalTarget", num_outputs=4, need_rng=True)
+def _proposal_target(params, rois, gt_boxes):
+    """Faster-RCNN ROI sampling + regression targets (fork
+    src/operator/proposal_target-inl.h:26-199, proposal_target.cc:22-164).
+
+    rois (B, R, 5) [batch_idx,x1,y1,x2,y2]; gt_boxes (B, G, 5)
+    [x1,y1,x2,y2,cls] with cls == -1 marking padding. Outputs:
+    rois (batch_rois, 5), label (batch_rois,), bbox_target / bbox_weight
+    (batch_rois, num_classes*4). Gradients are zero (reference Backward
+    writes zeros) — the whole op sits under stop_gradient.
+    """
+    rois = lax.stop_gradient(rois)
+    gt_boxes = lax.stop_gradient(gt_boxes)
+    num_classes = int(params["num_classes"])
+    batch_images = int(params["batch_images"])
+    batch_rois = int(params["batch_rois"])
+    rois_per_image = batch_rois // batch_images
+    fg_cap = int(rois_per_image * float(params.get("fg_fraction", 0.25)))
+    mean, std, weight = _pt_params(params)
+    B = rois.shape[0]
+    keys = jax.random.split(params["_rng_key"], B)
+
+    def one(key, rois_i, gt_i, idx):
+        r = _sample_rois_one_image(
+            key, rois_i, gt_i, idx, rois_per_image=rois_per_image,
+            fg_cap=fg_cap, num_classes=num_classes,
+            fg_thresh=float(params["fg_thresh"]),
+            bg_hi=float(params["bg_thresh_hi"]),
+            bg_lo=float(params["bg_thresh_lo"]),
+            without_gt=bool(params["proposal_without_gt"]),
+            mean=mean, std=std, weight=weight)
+        return r[:4]
+
+    out_rois, labels, targets, weights = jax.vmap(one)(
+        keys, rois, gt_boxes, jnp.arange(B))
+    return (out_rois.reshape(batch_rois, 5),
+            labels.reshape(batch_rois),
+            targets.reshape(batch_rois, num_classes * 4),
+            weights.reshape(batch_rois, num_classes * 4))
+
+
+def _rasterize_poly(poly, roi, mask_size, num_classes):
+    """Rasterize one encoded polygon onto the roi-aligned mask grid
+    (proposal_mask_target.cc:20-81 convertPoly2Mask).
+
+    poly layout: [category, n_seg, len_0..len_{n_seg-1}, x0,y0,x1,y1,...].
+    The reference round-trips through COCO RLE (rleFrPoly+rleDecode); we
+    evaluate the even-odd rule at pixel centers on the mask grid — same
+    fill, boundary pixels may differ by one.
+    Returns (num_classes, S, S): -1 everywhere except the polygon's
+    category channel which holds the {0,1} mask.
+    """
+    S = mask_size
+    P = poly.shape[0]
+    w = jnp.maximum(roi[3] - roi[1], 1.0)
+    h = jnp.maximum(roi[4] - roi[2], 1.0)
+    cat = poly[0].astype(jnp.int32)
+    n_seg = poly[1].astype(jnp.int32)
+
+    max_seg = P - 2
+    seg_idx = jnp.arange(max_seg)
+    lens = jnp.where(seg_idx < n_seg,
+                     jnp.take(poly, 2 + seg_idx, mode="clip"), 0.0)
+    verts_per_seg = (lens // 2).astype(jnp.int32)
+    vcum = jnp.cumsum(verts_per_seg)
+    total_verts = vcum[-1] if max_seg else jnp.int32(0)
+
+    Vmax = (P - 2) // 2
+    v = jnp.arange(Vmax)
+    base = 2 + n_seg
+    x = (jnp.take(poly, base + 2 * v, mode="clip") - roi[1]) * S / w
+    y = (jnp.take(poly, base + 2 * v + 1, mode="clip") - roi[2]) * S / h
+    valid_v = v < total_verts
+    seg_of_v = jnp.searchsorted(vcum, v, side="right")
+    seg_end = jnp.take(vcum, seg_of_v, mode="clip")
+    seg_start = seg_end - jnp.take(verts_per_seg, seg_of_v, mode="clip")
+    nxt = jnp.where(v + 1 < seg_end, v + 1, seg_start)
+    x2 = jnp.take(x, nxt, mode="clip")
+    y2 = jnp.take(y, nxt, mode="clip")
+
+    # even-odd crossing count at pixel centers
+    cx = jnp.arange(S) + 0.5                                  # (S,)
+    cy = (jnp.arange(S) + 0.5)[:, None]                       # (S,1)
+    crosses = (y[:, None, None] > cy) != (y2[:, None, None] > cy)  # (V,S,1)
+    xs = x[:, None, None] + (cy - y[:, None, None]) * (
+        x2[:, None, None] - x[:, None, None]) / jnp.where(
+            y2[:, None, None] - y[:, None, None] == 0, 1.0,
+            y2[:, None, None] - y[:, None, None])
+    hits = crosses & (cx[None, None, :] < xs) & valid_v[:, None, None]
+    inside = (jnp.sum(hits, axis=0) % 2).astype(poly.dtype)   # (S,S)
+
+    chan = jnp.arange(num_classes)[:, None, None]
+    return jnp.where(chan == cat, inside[None], -1.0)
+
+
+@register("ProposalMaskTarget", num_outputs=5, need_rng=True)
+def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
+    """Mask-RCNN ROI sampling: ProposalTarget plus per-foreground-roi mask
+    targets (fork src/operator/proposal_mask_target-inl.h:26-216,
+    proposal_mask_target.cc:20-202; COCO RLE utils src/coco_api/).
+
+    gt_polys (B, G, poly_len) encodes each instance's segmentation.
+    Extra output mask_target (batch_images*img_rois*fg_fraction,
+    num_classes, mask_size, mask_size), -1 off-category / non-fg.
+    """
+    rois = lax.stop_gradient(rois)
+    gt_boxes = lax.stop_gradient(gt_boxes)
+    gt_polys = lax.stop_gradient(gt_polys)
+    num_classes = int(params["num_classes"])
+    batch_images = int(params["batch_images"])
+    img_rois = int(params["img_rois"])
+    mask_size = int(params["mask_size"])
+    fg_fraction = float(params.get("fg_fraction", 0.25))
+    fg_cap = int(img_rois * fg_fraction)
+    mean, std, weight = _pt_params(params)
+    B = rois.shape[0]
+    keys = jax.random.split(params["_rng_key"], B)
+
+    def one(key, rois_i, gt_i, polys_i, idx):
+        kept_rows, labels, targets, weights, gt_assign, n_fg = \
+            _sample_rois_one_image(
+                key, rois_i, gt_i, idx, rois_per_image=img_rois,
+                fg_cap=fg_cap, num_classes=num_classes,
+                fg_thresh=float(params["fg_thresh"]),
+                bg_hi=float(params["bg_thresh_hi"]),
+                bg_lo=float(params["bg_thresh_lo"]),
+                without_gt=bool(params["proposal_without_gt"]),
+                mean=mean, std=std, weight=weight)
+
+        def mask_row(j):
+            m = _rasterize_poly(polys_i[gt_assign[j]], kept_rows[j],
+                                mask_size, num_classes)
+            return jnp.where(j < n_fg, m,
+                             jnp.full_like(m, -1.0))
+        masks = jax.vmap(mask_row)(jnp.arange(fg_cap))
+        return kept_rows, labels, targets, weights, masks
+
+    out_rois, labels, targets, weights, masks = jax.vmap(one)(
+        keys, rois, gt_boxes, gt_polys, jnp.arange(B))
+    batch_rois = batch_images * img_rois
+    return (out_rois.reshape(batch_rois, 5),
+            labels.reshape(batch_rois),
+            targets.reshape(batch_rois, num_classes * 4),
+            weights.reshape(batch_rois, num_classes * 4),
+            masks.reshape(batch_images * fg_cap, num_classes,
+                          mask_size, mask_size))
+
+
+@register("PostDetection", num_outputs=2, need_train_flag=True)
+def _post_detection(params, rois, scores, bbox_deltas, im_info):
+    """Test-time detection post-processing: box decode + clip,
+    foreground-enhanced score renormalisation, then weighted NMS (fork
+    src/operator/post_detection_op-inl.h:19-156, post_detection_op.cc:10-246).
+
+    rois (B*N, 5), scores (B, N, C), bbox_deltas (B, N, 4C), im_info (B, 3).
+    Outputs batch_boxes (B, N, 6) [x1,y1,x2,y2,score,cls] and
+    batch_boxes_rois (B*N, 5) [b,x1,y1,x2,y2], zero-padded past the kept
+    count. One deviation: the reference's weighted-NMS accumulates scores
+    indexed by loop position (post_detection_op.cc:108 `scores[j]`) rather
+    than by box id — an indexing bug we do not reproduce; we weight each
+    merged box by its own score.
+    """
+    if params.get("_is_train"):
+        raise ValueError("PostDetection is test-mode only "
+                         "(reference post_detection_op-inl.h:81-83)")
+    thresh = float(params.get("thresh", 0.9))
+    lo = float(params.get("nms_thresh_lo", 0.3))
+    hi = float(params.get("nms_thresh_hi", 0.5))
+    B, N, C = scores.shape
+    rois = rois.reshape(B, N, 5)
+    deltas = bbox_deltas.reshape(B, N, C, 4)
+    # per-image clip bounds (the reference clips every image to image 0's
+    # dims, post_detection_op.cc:153-154 — we honour each im_info row)
+    im_h, im_w = im_info[:, 0], im_info[:, 1]                  # (B,)
+
+    # decode + clip (nonlinear_clip, post_detection_op.cc:10-41)
+    w = rois[..., 3] - rois[..., 1] + 1.0
+    h = rois[..., 4] - rois[..., 2] + 1.0
+    cx = rois[..., 1] + 0.5 * (w - 1.0)
+    cy = rois[..., 2] + 0.5 * (h - 1.0)
+    pcx = deltas[..., 0] * w[..., None] + cx[..., None]
+    pcy = deltas[..., 1] * h[..., None] + cy[..., None]
+    pw = jnp.exp(deltas[..., 2]) * w[..., None]
+    ph = jnp.exp(deltas[..., 3]) * h[..., None]
+    pred = jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                     axis=-1)                                  # (B,N,C,4)
+    limits = jnp.stack([im_w, im_h, im_w, im_h], axis=-1) - 1.0  # (B,4)
+    pred = jnp.clip(pred, 0.0, limits[:, None, None, :])
+
+    # foreground/background score enhancement (_fore_back_enhance)
+    mx = jnp.max(scores, axis=-1, keepdims=True)
+    enh = jnp.where(scores >= mx, scores, 0.0)
+    enh = enh.at[..., 0].set(scores[..., 0])
+    enh = enh / jnp.sum(enh, axis=-1, keepdims=True)
+
+    # per-roi class pick: LAST foreground class above thresh (the
+    # reference's c-outer scan overwrites with the largest passing c)
+    elig = enh[..., 1:] > thresh                               # (B,N,C-1)
+    keep = jnp.any(elig, axis=-1)
+    cls = C - 1 - jnp.argmax(elig[..., ::-1], axis=-1)         # (B,N)
+    score = jnp.take_along_axis(enh, cls[..., None], axis=-1)[..., 0]
+    box = jnp.take_along_axis(
+        pred, cls[..., None, None].repeat(4, -1), axis=2)[:, :, 0, :]
+
+    def nms_one(keep0, score0, cls0, boxes):
+        x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+        areas = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+
+        def cond(st):
+            remaining, _, k = st
+            return jnp.any(remaining) & (k < N)
+
+        def body(st):
+            remaining, out, k = st
+            i = jnp.argmax(jnp.where(remaining, score0, -jnp.inf))
+            xx1 = jnp.maximum(x1[i], x1)
+            yy1 = jnp.maximum(y1[i], y1)
+            xx2 = jnp.minimum(x2[i], x2)
+            yy2 = jnp.minimum(y2[i], y2)
+            inter = (jnp.maximum(xx2 - xx1 + 1.0, 0.0)
+                     * jnp.maximum(yy2 - yy1 + 1.0, 0.0))
+            iou = inter / (areas[i] + areas - inter)
+            merge = remaining & (iou > hi)
+            tmp = jnp.sum(jnp.where(merge, score0, 0.0))
+            avg = lambda q: jnp.sum(jnp.where(merge, score0 * q, 0.0)) / tmp
+            row = jnp.stack([avg(xx1), avg(yy1), avg(xx2), avg(yy2),
+                             score0[i], cls0[i].astype(score0.dtype)])
+            out = out.at[k].set(row)
+            return remaining & (iou <= lo), out, k + 1
+
+        _, out, k = lax.while_loop(
+            cond, body, (keep0, jnp.zeros((N, 6), boxes.dtype), 0))
+        return out, k
+
+    batch_boxes, _ = jax.vmap(nms_one)(keep, score, cls, box)
+    b_idx = jnp.broadcast_to(
+        jnp.arange(B, dtype=batch_boxes.dtype)[:, None], (B, N))
+    nonzero = jnp.any(batch_boxes != 0, axis=-1)
+    out_rois = jnp.concatenate(
+        [jnp.where(nonzero, b_idx, 0.0)[..., None],
+         batch_boxes[..., :4]], axis=-1)
+    return batch_boxes, out_rois.reshape(B * N, 5)

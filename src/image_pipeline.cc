@@ -32,6 +32,7 @@
 
 #include "c_api.h"
 #include "error.h"
+#include "recordio_format.h"
 
 namespace mxtpu {
 
@@ -40,8 +41,6 @@ void DecodeImage(const unsigned char *buf, size_t size, int flag,
                  std::vector<unsigned char> *out, int *h, int *w, int *c);
 void BilinearResize(const unsigned char *src, int sh, int sw, int c,
                     unsigned char *dst, int dh, int dw);
-
-static const uint32_t kMagic = 0xced7230a;
 
 struct IRHeader {
   uint32_t flag;
@@ -121,7 +120,8 @@ class ImagePipeline {
       size_t n = offsets_.size();
       size_t begin = n * part_index / num_parts;
       size_t end = n * (part_index + 1) / num_parts;
-      offsets_.assign(offsets_.begin() + begin, offsets_.begin() + end);
+      std::vector<size_t>(offsets_.begin() + begin,
+                          offsets_.begin() + end).swap(offsets_);
     }
     if (offsets_.empty())
       throw std::runtime_error("record file has no records: " + rec_path);
@@ -182,10 +182,10 @@ class ImagePipeline {
       if (n != 8) throw std::runtime_error("recordio: truncated header");
       if (head[0] != kMagic)
         throw std::runtime_error("recordio: bad magic while indexing");
-      uint32_t cflag = head[1] >> 29U;
-      uint32_t len = head[1] & ((1U << 29) - 1U);
-      if (cflag == 0 || cflag == 1) offsets_.push_back(pos);
-      pos += 8 + ((len + 3) & ~3U);
+      uint32_t cflag = DecodeFlag(head[1]);
+      uint32_t len = DecodeLength(head[1]);
+      if (StartsRecord(cflag)) offsets_.push_back(pos);
+      pos += 8 + PaddedSize(len);
     }
   }
 
@@ -198,8 +198,8 @@ class ImagePipeline {
       if (::pread(fd_, head, 8, pos) != 8)
         throw std::runtime_error("recordio: truncated record");
       if (head[0] != kMagic) throw std::runtime_error("recordio: bad magic");
-      uint32_t cflag = head[1] >> 29U;
-      uint32_t len = head[1] & ((1U << 29) - 1U);
+      uint32_t cflag = DecodeFlag(head[1]);
+      uint32_t len = DecodeLength(head[1]);
       if (!first) {
         const unsigned char *m =
             reinterpret_cast<const unsigned char *>(&kMagic);
@@ -211,8 +211,8 @@ class ImagePipeline {
           ::pread(fd_, out.data() + old, len, pos + 8) !=
               static_cast<ssize_t>(len))
         throw std::runtime_error("recordio: truncated payload");
-      pos += 8 + ((len + 3) & ~3U);
-      if (cflag == 0 || cflag == 3) return out;
+      pos += 8 + PaddedSize(len);
+      if (EndsRecord(cflag)) return out;
       first = false;
     }
   }

@@ -24,17 +24,9 @@
 
 #include "c_api.h"
 #include "error.h"
+#include "recordio_format.h"
 
 namespace mxtpu {
-
-static const uint32_t kMagic = 0xced7230a;
-static const uint32_t kLenMask = (1U << 29) - 1U;
-
-inline uint32_t EncodeLRec(uint32_t cflag, uint32_t len) {
-  return (cflag << 29U) | len;
-}
-inline uint32_t DecodeFlag(uint32_t rec) { return rec >> 29U; }
-inline uint32_t DecodeLength(uint32_t rec) { return rec & kLenMask; }
 
 class RecordIOWriter {
  public:

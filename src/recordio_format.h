@@ -1,0 +1,36 @@
+// RecordIO on-disk framing, shared by the writer/reader (recordio.cc) and
+// the threaded image pipeline's pread-based reader (image_pipeline.cc).
+//
+//   [kMagic : u32][lrecord : u32][payload][pad to 4B]
+//
+// lrecord packs cflag (upper 3 bits) | length (lower 29 bits). Payloads
+// containing the magic word are split into chunks at those points (the
+// magic is elided on disk and re-inserted on read): cflag 0 = whole
+// record, 1 = first chunk, 2 = middle chunk, 3 = last chunk.
+// Format parity: reference 3rdparty/dmlc-core recordio
+// (docs/faq/recordio.md), consumed by src/io/iter_image_recordio_2.cc.
+#ifndef MXTPU_RECORDIO_FORMAT_H_
+#define MXTPU_RECORDIO_FORMAT_H_
+
+#include <cstdint>
+
+namespace mxtpu {
+
+static const uint32_t kMagic = 0xced7230a;
+static const uint32_t kLenMask = (1U << 29) - 1U;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t len) {
+  return (cflag << 29U) | len;
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return rec >> 29U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & kLenMask; }
+// bytes a chunk of payload length `len` occupies after its 8-byte header
+inline size_t PaddedSize(uint32_t len) { return (len + 3U) & ~3U; }
+// a chunk with this cflag starts a logical record
+inline bool StartsRecord(uint32_t cflag) { return cflag == 0 || cflag == 1; }
+// a chunk with this cflag ends a logical record
+inline bool EndsRecord(uint32_t cflag) { return cflag == 0 || cflag == 3; }
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_RECORDIO_FORMAT_H_

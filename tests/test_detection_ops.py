@@ -297,3 +297,185 @@ def test_multibox_detection_nonzero_background_id():
                     {"background_id": 2}, cls_prob, loc_pred, anchors)
     assert out[0, 0, 0] == 0.0          # class 0 keeps id 0
     assert out[0, 0, 1] == np.float32(0.9)
+
+
+# ---------------------------------------------------------------------------
+# Fork RCNN target ops
+# ---------------------------------------------------------------------------
+
+def _pt_inputs():
+    rng = np.random.RandomState(7)
+    B, R, G = 2, 40, 4
+    gt = np.zeros((B, G, 5), np.float32)
+    for b in range(B):
+        for g in range(G - 1):  # last row padding (-1)
+            x1, y1 = rng.uniform(0, 60, 2)
+            gt[b, g] = [x1, y1, x1 + rng.uniform(10, 40),
+                        y1 + rng.uniform(10, 40), rng.randint(1, 4)]
+        gt[b, G - 1, 4] = -1
+    rois = np.zeros((B, R, 5), np.float32)
+    for b in range(B):
+        for r in range(R):
+            if r < R // 2:  # half jittered around a gt box → fg candidates
+                g = rng.randint(0, G - 1)
+                jit = rng.uniform(-3, 3, 4)
+                rois[b, r] = [b, *(gt[b, g, :4] + jit)]
+            else:
+                x1, y1 = rng.uniform(0, 80, 2)
+                rois[b, r] = [b, x1, y1, x1 + rng.uniform(5, 30),
+                              y1 + rng.uniform(5, 30)]
+    return rois, gt
+
+
+def test_proposal_target_shapes_and_semantics():
+    import jax
+    rois, gt = _pt_inputs()
+    params = {"num_classes": 4, "batch_images": 2, "batch_rois": 32,
+              "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+              "proposal_without_gt": True,
+              "_rng_key": jax.random.PRNGKey(0)}
+    out, label, tgt, wt = run_op("ProposalTarget", params, rois, gt)
+    assert out.shape == (32, 5) and label.shape == (32,)
+    assert tgt.shape == (32, 16) and wt.shape == (32, 16)
+    # batch index column is the image id
+    assert set(out[:16, 0]) == {0.0} and set(out[16:, 0]) == {1.0}
+    # fg fraction cap: at most 8 fg per image
+    for img in range(2):
+        lab = label[img * 16:(img + 1) * 16]
+        nfg = int((lab > 0).sum())
+        assert 0 < nfg <= 8
+        # fg rows come first
+        assert all(lab[:nfg] > 0) and all(lab[nfg:] == 0)
+    # targets/weights nonzero exactly in the labelled class columns
+    for i in range(32):
+        cls = int(label[i])
+        nz = wt[i].reshape(4, 4)
+        if cls > 0:
+            assert np.all(nz[cls] == 1.0)
+            nz_other = np.delete(nz, cls, axis=0)
+            assert np.all(nz_other == 0.0)
+        else:
+            assert np.all(nz == 0.0)
+    # every output roi is one of the input rois of its image
+    for img in range(2):
+        pool = {tuple(np.round(r, 3)) for r in rois[img]}
+        for r in out[img * 16:(img + 1) * 16]:
+            assert tuple(np.round(r, 3)) in pool
+
+
+def test_proposal_target_regression_oracle():
+    """Check the bbox-target math on a deterministic 1-roi case."""
+    import jax
+    rois = np.array([[[0, 10, 10, 29, 29]]], np.float32)
+    gt = np.array([[[12, 8, 33, 31, 2]]], np.float32)
+    params = {"num_classes": 3, "batch_images": 1, "batch_rois": 1,
+              "fg_thresh": 0.3, "bg_thresh_hi": 0.3, "bg_thresh_lo": 0.0,
+              "proposal_without_gt": True, "fg_fraction": 1.0,
+              "bbox_mean": (0, 0, 0, 0), "bbox_std": (1, 1, 1, 1),
+              "_rng_key": jax.random.PRNGKey(1)}
+    out, label, tgt, wt = run_op("ProposalTarget", params, rois, gt)
+    assert label[0] == 2.0
+    ew = eh = 20.0
+    ecx, ecy = 19.5, 19.5
+    gw, gh = 22.0, 24.0
+    gcx, gcy = 22.5, 19.5
+    want = [(gcx - ecx) / ew, (gcy - ecy) / eh,
+            math.log(gw / ew), math.log(gh / eh)]
+    np.testing.assert_allclose(tgt[0, 8:12], want, rtol=1e-5, atol=1e-6)
+    assert np.all(tgt[0, :8] == 0) and np.all(tgt[0, 12:] == 0)
+
+
+def test_proposal_mask_target_rasterizes_rectangle():
+    import jax
+    # one roi exactly covering a square gt whose polygon is the left half
+    rois = np.array([[[0, 0, 0, 15, 15]]], np.float32)
+    gt = np.array([[[0, 0, 15, 15, 1]]], np.float32)
+    # poly: category 1, 1 segment, 8 coords: rectangle x in [0,8), y in [0,16)
+    poly = np.zeros((1, 1, 16), np.float32)
+    poly[0, 0, :3] = [1, 1, 8]
+    poly[0, 0, 3:11] = [0, 0, 8, 0, 8, 16, 0, 16]
+    params = {"num_classes": 2, "batch_images": 1, "img_rois": 1,
+              "poly_len": 16, "mask_size": 8, "fg_fraction": 1.0,
+              "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+              "proposal_without_gt": True,
+              "_rng_key": jax.random.PRNGKey(0)}
+    out, label, tgt, wt, mask = run_op("ProposalMaskTarget", params,
+                                       rois, gt, poly)
+    assert mask.shape == (1, 2, 8, 8)
+    assert label[0] == 1.0
+    # roi w=h=15 → scale 8/15; poly x<8 maps to mask x < 8*8/15 ≈ 4.27
+    # → columns 0..3 inside, 4..7 outside; full y range
+    np.testing.assert_array_equal(mask[0, 1, :, :4], 1.0)
+    np.testing.assert_array_equal(mask[0, 1, :, 4:], 0.0)
+    # background channel untouched
+    np.testing.assert_array_equal(mask[0, 0], -1.0)
+
+
+def test_proposal_mask_target_bg_rows_minus1():
+    import jax
+    rois, gt = _pt_inputs()
+    poly = np.zeros((2, 4, 20), np.float32)
+    poly[:, :, 0] = gt[:, :, 4]  # category
+    poly[:, :, 1] = 1
+    poly[:, :, 2] = 8
+    for b in range(2):
+        for g in range(4):
+            x1, y1, x2, y2 = gt[b, g, :4]
+            poly[b, g, 3:11] = [x1, y1, x2, y1, x2, y2, x1, y2]
+    params = {"num_classes": 4, "batch_images": 2, "img_rois": 16,
+              "poly_len": 20, "mask_size": 4, "fg_thresh": 0.5,
+              "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+              "proposal_without_gt": True,
+              "_rng_key": jax.random.PRNGKey(3)}
+    out, label, tgt, wt, mask = run_op("ProposalMaskTarget", params,
+                                       rois, gt, poly)
+    assert mask.shape == (8, 4, 4, 4)  # 2 imgs * 16*0.25 fg slots
+    for img in range(2):
+        lab = label[img * 16:(img + 1) * 16]
+        nfg = int((lab > 0).sum())
+        m = mask[img * 4:(img + 1) * 4]
+        for j in range(4):
+            if j < nfg:
+                cls = int(lab[j])
+                assert np.all(np.isin(m[j, cls], [0.0, 1.0]))
+            else:
+                assert np.all(m[j] == -1.0)
+
+
+def test_post_detection_weighted_nms():
+    import jax
+    B, N, C = 1, 6, 3
+    rois = np.zeros((B * N, 5), np.float32)
+    # two clusters of overlapping boxes + identity deltas
+    base = [[0, 0, 10, 10], [1, 1, 11, 11], [0.5, 0, 10.5, 10],
+            [40, 40, 60, 60], [42, 41, 61, 62], [80, 0, 90, 10]]
+    for i, b in enumerate(base):
+        rois[i, 1:] = b
+    deltas = np.zeros((B, N, 4 * C), np.float32)
+    scores = np.zeros((B, N, C), np.float32)
+    scores[0, :, 1] = [0.97, 0.96, 0.95, 0.0, 0.0, 0.2]
+    scores[0, :, 2] = [0.0, 0.0, 0.0, 0.98, 0.96, 0.3]
+    scores[0, :, 0] = 1.0 - scores[0].sum(-1)
+    im_info = np.array([[100, 100, 1]], np.float32)
+    params = {"thresh": 0.9, "nms_thresh_lo": 0.3, "nms_thresh_hi": 0.5,
+              "_is_train": False}
+    boxes, out_rois = run_op("PostDetection", params, rois, scores,
+                             deltas, im_info)
+    assert boxes.shape == (B, N, 6) and out_rois.shape == (B * N, 5)
+    kept = boxes[0][np.any(boxes[0] != 0, axis=-1)]
+    # the two clusters collapse to one detection each (scores > 0.9
+    # after enhancement); the weak lone box (0.2/0.3) is below thresh
+    assert kept.shape[0] == 2
+    assert kept[0, 4] >= 0.9 and kept[0, 5] in (1.0, 2.0)
+    cls2 = kept[kept[:, 5] == 2.0]
+    assert len(cls2) == 1 and 39 < cls2[0, 0] < 62
+    # rois output mirrors box coords with batch index 0
+    nz = out_rois[np.any(out_rois[:, 1:] != 0, axis=-1)]
+    np.testing.assert_allclose(nz[:, 1:], kept[:, :4], rtol=1e-5)
+
+
+def test_post_detection_train_mode_raises():
+    with pytest.raises(ValueError):
+        run_op("PostDetection", {"_is_train": True},
+               np.zeros((2, 5), np.float32), np.zeros((1, 2, 2), np.float32),
+               np.zeros((1, 2, 8), np.float32), np.ones((1, 3), np.float32))
