@@ -1,0 +1,110 @@
+"""2-bit gradient compression with error feedback.
+
+Parity with reference `src/kvstore/gradient_compression.{h,cc,cu}`
+(`gradient_compression.h:37-39,52,121`; doc `docs/faq/gradient_compression.md`):
+each gradient element is quantized to 2 bits against a threshold —
+``01`` → +threshold, ``10`` → −threshold, ``00`` → 0 — and the quantization
+error is kept in a per-key *residual* that is added to the next gradient
+(error feedback), so small gradients accumulate until they cross the
+threshold instead of being dropped forever.
+
+TPU-native design: the quantize/dequantize passes are single jitted XLA
+computations (elementwise select + bit packing into ``uint8``, 4 codes per
+byte — a 16× wire-size reduction vs float32, same ratio as the reference's
+16-elements-per-float packing). There is no server to ship bytes to — the
+compressed form is what would ride DCN between hosts; within a slice the
+dequantized gradient rides ICI collectives.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+__all__ = ["GradientCompression"]
+
+
+def _quantize_2bit_impl(grad, residual, threshold):
+    import jax.numpy as jnp
+
+    acc = residual + grad
+    pos = acc >= threshold
+    neg = acc <= -threshold
+    codes = jnp.where(pos, jnp.uint8(1), jnp.where(neg, jnp.uint8(2),
+                                                   jnp.uint8(0)))
+    new_residual = acc - jnp.where(pos, threshold, 0.0) \
+                       + jnp.where(neg, threshold, 0.0)
+    flat = codes.ravel()
+    pad = (-flat.size) % 4
+    flat = jnp.pad(flat, (0, pad))
+    quads = flat.reshape(-1, 4)
+    packed = (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+              | (quads[:, 3] << 6))
+    return packed, new_residual
+
+
+def _dequantize_2bit_impl(packed, threshold, size, dtype):
+    import jax.numpy as jnp
+
+    quads = jnp.stack([packed & 3, (packed >> 2) & 3, (packed >> 4) & 3,
+                       (packed >> 6) & 3], axis=1).ravel()[:size]
+    lut = jnp.asarray([0.0, threshold, -threshold, 0.0], dtype=dtype)
+    return lut[quads]
+
+
+class GradientCompression:
+    """Stateful compressor: one residual buffer per key (error feedback).
+
+    ``compress(key, grad)`` returns the dequantized gradient that the wire
+    would deliver (quantize → pack → unpack → dequantize), updating the
+    residual, matching the reference's Quantize/Dequantize pair around
+    ZPush/ZPull (`src/kvstore/kvstore_dist.h:201-234`).
+    """
+
+    def __init__(self, compression_params=None):
+        params = dict(compression_params or {})
+        self.type = params.get("type", "2bit")
+        if self.type != "2bit":
+            raise ValueError("unsupported compression type %r" % self.type)
+        self.threshold = float(params.get("threshold", 0.5))
+        if self.threshold <= 0:
+            raise ValueError("threshold must be positive")
+        self._residuals = {}
+        self._jit_quantize = None
+        self._jit_dequantize = None
+
+    def get_params(self):
+        return {"type": self.type, "threshold": str(self.threshold)}
+
+    # -- raw jitted kernels (testable directly) --------------------------
+    def quantize(self, grad, residual):
+        """(packed uint8 codes, new residual) for a jnp gradient array."""
+        import jax
+        if self._jit_quantize is None:
+            self._jit_quantize = jax.jit(
+                partial(_quantize_2bit_impl, threshold=self.threshold))
+        return self._jit_quantize(grad, residual)
+
+    def dequantize(self, packed, shape, dtype):
+        import jax
+        import numpy as np
+        if self._jit_dequantize is None:
+            self._jit_dequantize = jax.jit(
+                partial(_dequantize_2bit_impl, threshold=self.threshold),
+                static_argnames=("size", "dtype"))
+        size = int(np.prod(shape)) if shape else 1
+        out = self._jit_dequantize(packed, size=size, dtype=dtype)
+        return out.reshape(shape)
+
+    # -- kvstore integration --------------------------------------------
+    def compress(self, key, nd_grad):
+        """Round-trip one NDArray gradient through the compressed wire."""
+        import jax.numpy as jnp
+        from .ndarray import NDArray
+
+        g = nd_grad._data
+        res = self._residuals.get(key)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros(g.shape, g.dtype)
+        packed, new_res = self.quantize(g, res)
+        self._residuals[key] = new_res
+        deq = self.dequantize(packed, g.shape, g.dtype)
+        return NDArray(deq, ctx=nd_grad.context)

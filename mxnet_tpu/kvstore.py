@@ -51,6 +51,7 @@ class KVStore:
         self._optimizer = None
         self._key_type = None
         self._compression = {}
+        self._gc = None
 
     # -- identity --------------------------------------------------------
     @property
@@ -88,6 +89,10 @@ class KVStore:
                 raise MXNetError("key %s not initialized" % str(k))
             vs = vs if isinstance(vs, list) else [vs]
             merged = _ctx_group_sum(vs)
+            if self._gc is not None:
+                # reference compresses after the local device reduce, before
+                # the network hop (kvstore_dist.h:201-234)
+                merged = self._gc.compress(k, merged)
             if self.num_workers > 1:
                 merged = self._allreduce(merged)
             stored = self._store[k]
@@ -131,10 +136,13 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        """Reference 2-bit gradient compression (`gradient_compression.h`).
-        On TPU, gradients ride ICI collectives; compression is a no-op knob
-        kept for API parity (recorded for introspection)."""
+        """Enable 2-bit gradient compression with error feedback
+        (reference `gradient_compression.h:37-39,52`): every subsequent
+        push quantizes the locally-reduced gradient to {−t, 0, +t} codes,
+        carrying the quantization error into the next push."""
+        from .gradient_compression import GradientCompression
         self._compression = dict(compression_params)
+        self._gc = GradientCompression(compression_params)
 
     # -- distributed -----------------------------------------------------
     def _allreduce(self, merged):

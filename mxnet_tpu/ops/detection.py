@@ -822,10 +822,18 @@ def _sample_rois_one_image(key, rois_i, gt_i, img_idx, *, rois_per_image,
                     jnp.where(sel_bg, bg_rank,
                               jnp.where(sel_pad, pad_rank,
                                         jnp.arange(N, dtype=jnp.int32))))
-    kept = jnp.argsort(cat * (N + 1) + tie)[:rois_per_image]
+    order = jnp.argsort(cat * (N + 1) + tie)[:rois_per_image]
 
+    # when fg+bg+pad together can't fill the quota, duplicate selected rows
+    # (with their labels) instead of leaking unselected cat-3 rows as fake
+    # background. (The reference pads by re-sampling the pools with
+    # replacement, proposal_target.cc; duplicating the selection is the
+    # static-shape equivalent.)
+    n_sel = n_fg + n_bg + jnp.sum(sel_pad)
     pos = jnp.arange(rois_per_image)
-    labels = jnp.where(pos < n_fg, cand_label[kept], 0.0)
+    src = jnp.where(pos < n_sel, pos, pos % jnp.maximum(n_sel, 1))
+    kept = order[src]
+    labels = jnp.where(src < n_fg, cand_label[kept], 0.0)
     kept_rows = cand[kept]
 
     gt_assign_kept = assignment[kept]
@@ -1083,8 +1091,11 @@ def _post_detection(params, rois, scores, bbox_deltas, im_info):
             iou = inter / (areas[i] + areas - inter)
             merge = remaining & (iou > hi)
             tmp = jnp.sum(jnp.where(merge, score0, 0.0))
+            # score-weighted average of the merged boxes' OWN corners
+            # (post_detection_op.cc accumulates the boxes' coordinates,
+            # not the intersection-clipped ones)
             avg = lambda q: jnp.sum(jnp.where(merge, score0 * q, 0.0)) / tmp
-            row = jnp.stack([avg(xx1), avg(yy1), avg(xx2), avg(yy2),
+            row = jnp.stack([avg(x1), avg(y1), avg(x2), avg(y2),
                              score0[i], cls0[i].astype(score0.dtype)])
             out = out.at[k].set(row)
             return remaining & (iou <= lo), out, k + 1

@@ -1,0 +1,79 @@
+"""2-bit gradient compression tests.
+
+Mirrors the semantics exercised by the reference's
+`tests/nightly/dist_sync_kvstore.py` compressed push-pull checks and
+`docs/faq/gradient_compression.md`: thresholding, error feedback
+accumulation, wire-size ratio.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gradient_compression import GradientCompression
+
+
+def test_quantize_dequantize_mapping():
+    import jax.numpy as jnp
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    g = jnp.asarray([0.6, -0.7, 0.1, -0.1, 0.0, 2.0, -2.0], jnp.float32)
+    res = jnp.zeros_like(g)
+    packed, new_res = gc.quantize(g, res)
+    out = np.asarray(gc.dequantize(packed, g.shape, jnp.float32))
+    # elements past +/-threshold send one threshold step; small ones send 0
+    np.testing.assert_allclose(out, [0.5, -0.5, 0, 0, 0, 0.5, -0.5])
+    # residual keeps what was not sent
+    np.testing.assert_allclose(
+        np.asarray(new_res), [0.1, -0.2, 0.1, -0.1, 0.0, 1.5, -1.5],
+        rtol=1e-6, atol=1e-6)
+
+
+def test_wire_size_is_16x_smaller():
+    import jax.numpy as jnp
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    g = jnp.zeros((1024,), jnp.float32)
+    packed, _ = gc.quantize(g, g)
+    assert packed.dtype == jnp.uint8
+    assert packed.size == 1024 // 4  # 2 bits/elem: 16x vs float32 bytes
+
+
+def test_error_feedback_accumulates():
+    """Pushing a constant sub-threshold gradient must eventually deliver
+    threshold steps at the right average rate (error feedback)."""
+    import jax.numpy as jnp
+    gc = GradientCompression({"type": "2bit", "threshold": 1.0})
+    g = jnp.full((4,), 0.3, jnp.float32)
+    res = jnp.zeros_like(g)
+    delivered = np.zeros(4, np.float32)
+    for _ in range(10):
+        packed, res = gc.quantize(g, res)
+        delivered += np.asarray(gc.dequantize(packed, g.shape, jnp.float32))
+    # 10 pushes of 0.3 = 3.0 total; with threshold 1.0 exactly 3 steps sent
+    np.testing.assert_allclose(delivered, 3.0)
+    np.testing.assert_allclose(np.asarray(res), 0.0, atol=1e-5)
+
+
+def test_kvstore_compressed_push_pull():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    shape = (8, 4)
+    kv.init("w", mx.nd.zeros(shape))
+    big = mx.nd.ones(shape) * 0.9
+    kv.push("w", big)
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    # one step of +0.5 lands; 0.4 stays in the residual
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+    kv.push("w", big)
+    kv.pull("w", out=out)
+    # 2-bit codes saturate at one threshold step per push; residual grows
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+    np.testing.assert_allclose(
+        np.asarray(kv._gc._residuals["w"]), 0.8, rtol=1e-6)
+
+
+def test_kvstore_compression_params_recorded():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    assert kv._gc.threshold == 2.0
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "1bit"})
