@@ -37,6 +37,7 @@ from .kvstore import create as kvstore_create
 from . import callback
 from . import model
 from .model import FeedForward
+from . import rnn
 from . import gluon
 from . import image
 from . import profiler

@@ -604,7 +604,13 @@ def _rnn(params, data, parameters, state, *state_cell):
     p_drop = params.get("p", 0.0)
     d = 2 if bidir else 1
     T, B, I = data.shape
+    if state.shape[1] == 1 and B != 1:
+        # begin_state zeros are created batch-1 (symbolic shape inference
+        # has no unknown-batch placeholder); broadcast to the data batch
+        state = jnp.broadcast_to(state, (state.shape[0], B, state.shape[2]))
     c_in = state_cell[0] if (mode == "lstm" and state_cell) else jnp.zeros_like(state)
+    if c_in.shape[1] == 1 and B != 1:
+        c_in = jnp.broadcast_to(c_in, (c_in.shape[0], B, c_in.shape[2]))
     weights, biases = _unpack_rnn_params(parameters, num_layers, I, H, bidir, mode)
     x = data
     h_finals, c_finals = [], []

@@ -1,0 +1,5 @@
+"""Symbolic RNN API (reference python/mxnet/rnn/)."""
+from . import rnn_cell
+from .rnn_cell import *
+from .rnn import *
+from .io import *

@@ -1,0 +1,207 @@
+"""mx.rnn symbolic cell tests, modelled on the reference's
+tests/python/unittest/test_rnn.py strategy: shape-check unrolled graphs,
+fused-vs-unfused numerical consistency, weight pack/unpack round trips,
+BucketSentenceIter semantics."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _unroll_shapes(cell, T=3, B=2, I=10, **unroll_kw):
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(T)]
+    outputs, _ = cell.unroll(T, inputs, **unroll_kw)
+    outputs = mx.sym.Group(outputs) if isinstance(outputs, list) else outputs
+    shapes = {"t%d_data" % i: (B, I) for i in range(T)}
+    _, out_shapes, _ = outputs.infer_shape(**shapes)
+    return outputs, out_shapes
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(100, prefix="rnn_")
+    outputs, out_shapes = _unroll_shapes(cell, T=3, B=2, I=10)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    assert out_shapes == [(2, 100)] * 3
+
+
+def test_lstm_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(100, prefix="lstm_")
+    outputs, out_shapes = _unroll_shapes(cell, T=3, B=2, I=10)
+    assert sorted(cell.params._params.keys()) == [
+        "lstm_h2h_bias", "lstm_h2h_weight", "lstm_i2h_bias",
+        "lstm_i2h_weight"]
+    assert out_shapes == [(2, 100)] * 3
+
+
+def test_gru_cell_unroll_shapes():
+    cell = mx.rnn.GRUCell(100, prefix="gru_")
+    _, out_shapes = _unroll_shapes(cell, T=3, B=2, I=10)
+    assert out_shapes == [(2, 100)] * 3
+
+
+def test_stacked_and_bidirectional():
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.LSTMCell(16, prefix="l0_"))
+    cell.add(mx.rnn.LSTMCell(16, prefix="l1_"))
+    _, out_shapes = _unroll_shapes(cell, T=3, B=2, I=8)
+    assert out_shapes == [(2, 16)] * 3
+
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(16, prefix="bl_"), mx.rnn.LSTMCell(16, prefix="br_"))
+    _, out_shapes = _unroll_shapes(bi, T=3, B=2, I=8)
+    assert out_shapes == [(2, 32)] * 3
+
+
+def test_residual_zoneout_dropout():
+    base = mx.rnn.RNNCell(8, prefix="res_")
+    cell = mx.rnn.ResidualCell(base)
+    _, out_shapes = _unroll_shapes(cell, T=2, B=2, I=8)
+    assert out_shapes == [(2, 8)] * 2
+
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(8, prefix="zo_"), 0.3, 0.3)
+    _, out_shapes = _unroll_shapes(cell, T=2, B=2, I=8)
+    assert out_shapes == [(2, 8)] * 2
+
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.RNNCell(8, prefix="d0_"))
+    cell.add(mx.rnn.DropoutCell(0.5))
+    _, out_shapes = _unroll_shapes(cell, T=2, B=2, I=8)
+    assert out_shapes == [(2, 8)] * 2
+
+
+def test_fused_unroll_shapes_and_states():
+    cell = mx.rnn.FusedRNNCell(50, num_layers=2, mode="lstm", prefix="f_",
+                               get_next_state=True)
+    inputs = mx.sym.Variable("data")
+    outputs, states = cell.unroll(4, inputs, layout="NTC",
+                                  merge_outputs=True)
+    _, out_shapes, _ = mx.sym.Group([outputs] + states).infer_shape(
+        data=(2, 4, 10))
+    assert out_shapes[0] == (2, 4, 50)
+    assert out_shapes[1] == (2, 2, 50)  # h: (L, B, H)
+    assert out_shapes[2] == (2, 2, 50)  # c
+
+
+def test_fused_vs_unfused_consistency():
+    """Fused RNN op output == explicitly unrolled unfused cells with the
+    same (packed/unpacked) weights — the reference's fused/unfused parity
+    check (test_rnn.py test_unfuse)."""
+    T, B, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_")
+    data = mx.sym.Variable("data")
+    fo, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    x = rng.randn(B, T, I).astype("f")
+    nparam = sum(p.size for p in [
+        np.zeros((4 * H, I)), np.zeros((4 * H, H)),
+        np.zeros(4 * H), np.zeros(4 * H)])
+    flat = rng.randn(nparam).astype("f") * 0.1
+    ex = fo.simple_bind(ctx=mx.cpu(), data=(B, T, I))
+    args = dict(zip(fo.list_arguments(), ex.arg_arrays))
+    args["data"][:] = x
+    args["f_parameters"][:] = flat
+    fused_out = ex.forward()[0].asnumpy()
+
+    # unpack the flat vector and run the unfused stack
+    arg_dict = fused.unpack_weights({"f_parameters": mx.nd.array(flat)})
+    stack = fused.unfuse()
+    so, _ = stack.unroll(T, data, layout="NTC", merge_outputs=True)
+    ex2 = so.simple_bind(ctx=mx.cpu(), data=(B, T, I))
+    args2 = dict(zip(so.list_arguments(), ex2.arg_arrays))
+    args2["data"][:] = x
+    for k, v in arg_dict.items():
+        if k in args2:
+            args2[k][:] = v.asnumpy() if hasattr(v, "asnumpy") else v
+    unfused_out = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="gru", prefix="g_",
+                               bidirectional=True)
+    n = mx.ops.nn.rnn_param_size(2, 5, 8, True, "gru")
+    flat = mx.nd.array(np.random.RandomState(1).randn(n).astype("f"))
+    unpacked = cell.unpack_weights({"g_parameters": flat})
+    assert "g_parameters" not in unpacked
+    assert "g_l0_i2h_weight" in unpacked and "g_r1_h2h_bias" in unpacked
+    assert unpacked["g_l0_i2h_weight"].shape == (3 * 8, 5)
+    repacked = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["g_parameters"].asnumpy(),
+                               flat.asnumpy(), rtol=1e-6)
+
+
+def test_unfused_pack_unpack_roundtrip():
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_")
+    rng = np.random.RandomState(2)
+    args = {"lstm_i2h_weight": mx.nd.array(rng.randn(16, 3).astype("f")),
+            "lstm_i2h_bias": mx.nd.array(rng.randn(16).astype("f")),
+            "lstm_h2h_weight": mx.nd.array(rng.randn(16, 4).astype("f")),
+            "lstm_h2h_bias": mx.nd.array(rng.randn(16).astype("f"))}
+    unpacked = cell.unpack_weights(dict(args))
+    assert "lstm_i2h_i_weight" in unpacked
+    assert unpacked["lstm_i2h_f_weight"].shape == (4, 3)
+    repacked = cell.pack_weights(unpacked)
+    for k in args:
+        np.testing.assert_allclose(repacked[k].asnumpy(), args[k].asnumpy())
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["a", "b", "c"], ["a", "c"], ["b", "c", "a"],
+                 ["a", "b"], ["c"], ["a", "b", "c"]]
+    enc, vocab = mx.rnn.encode_sentences(sentences, start_label=1)
+    assert len(vocab) == 4  # 3 tokens + invalid key
+    assert all(all(isinstance(t, int) for t in s) for s in enc)
+
+    it = mx.rnn.BucketSentenceIter(enc, batch_size=2, buckets=[2, 3],
+                                   invalid_label=-1)
+    seen = 0
+    for batch in it:
+        seen += 1
+        assert batch.bucket_key in (2, 3)
+        assert batch.data[0].shape == (2, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        # label is data shifted one step left
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+    assert seen >= 2
+
+
+def test_conv_cells_shapes():
+    cell = mx.rnn.ConvLSTMCell(input_shape=(3, 8, 8), num_hidden=5,
+                               prefix="cl_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, out_shapes, _ = outputs.infer_shape(
+        t0_data=(1, 3, 8, 8), t1_data=(1, 3, 8, 8))
+    assert out_shapes == [(1, 5, 8, 8)] * 2
+
+
+def test_dropout_cell_merged_unroll():
+    cell = mx.rnn.DropoutCell(0.5)
+    outputs, states = cell.unroll(3, mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    assert isinstance(outputs, mx.sym.Symbol)
+    assert states == []
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 4))
+    assert out_shapes == [(2, 3, 4)]
+
+
+def test_unfused_bidirectional_stack_unrolls():
+    stack = mx.rnn.FusedRNNCell(4, num_layers=2, mode="lstm",
+                                bidirectional=True, prefix="fb_").unfuse()
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, _ = stack.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, out_shapes, _ = outputs.infer_shape(
+        **{"t%d_data" % i: (2, 5) for i in range(3)})
+    assert out_shapes == [(2, 8)] * 3  # 2 directions x 4 hidden
+
+
+def test_bucket_iter_empty_bucket():
+    it = mx.rnn.BucketSentenceIter([[1, 2], [2, 1], [1, 2]], batch_size=2,
+                                   buckets=[2, 5], invalid_label=-1)
+    batches = list(it)
+    assert all(b.bucket_key == 2 for b in batches)
