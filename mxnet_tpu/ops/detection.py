@@ -34,6 +34,15 @@ from .registry import register
 from .contrib_ops import box_iou_xyxy
 
 
+def _bool_param(params, key, default=False):
+    """Parse a boolean attr that may arrive as a string from symbol JSON
+    (MXNet serializes attrs as str; "False"/"0" must not be truthy)."""
+    v = params.get(key, default)
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes")
+    return bool(v)
+
+
 def _tuple_param(params, key, default):
     v = params.get(key, default)
     if isinstance(v, str):
@@ -895,7 +904,7 @@ def _proposal_target(params, rois, gt_boxes):
             fg_thresh=float(params["fg_thresh"]),
             bg_hi=float(params["bg_thresh_hi"]),
             bg_lo=float(params["bg_thresh_lo"]),
-            without_gt=bool(params["proposal_without_gt"]),
+            without_gt=_bool_param(params, "proposal_without_gt"),
             mean=mean, std=std, weight=weight)
         return r[:4]
 
@@ -992,7 +1001,7 @@ def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
                 fg_thresh=float(params["fg_thresh"]),
                 bg_hi=float(params["bg_thresh_hi"]),
                 bg_lo=float(params["bg_thresh_lo"]),
-                without_gt=bool(params["proposal_without_gt"]),
+                without_gt=_bool_param(params, "proposal_without_gt"),
                 mean=mean, std=std, weight=weight)
 
         def mask_row(j):
@@ -1112,3 +1121,170 @@ def _post_detection(params, rois, scores, bbox_deltas, im_info):
         [jnp.where(nonzero, b_idx, 0.0)[..., None],
          batch_boxes[..., :4]], axis=-1)
     return batch_boxes, out_rois.reshape(B * N, 5)
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive ROI pooling (R-FCN) and its deformable variant
+# ---------------------------------------------------------------------------
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def _psroi_pooling(params, data, rois):
+    """Position-sensitive ROI pooling (reference `src/operator/contrib/
+    psroi_pooling.cu` PSROIPoolForwardKernel): output bin (ctop, ph, pw)
+    average-pools the bin's spatial region from input channel
+    (ctop*G + gh)*G + gw, so each spatial position votes through its own
+    channel group (R-FCN).
+
+    TPU design: the variable-extent bin average becomes two static masked
+    contractions (one over H, one over W) — a single einsum per roi that
+    XLA maps onto the MXU; rois are vmapped.
+    """
+    spatial_scale = params["spatial_scale"]
+    D = int(params["output_dim"])
+    P = int(params["pooled_size"])
+    G = int(params.get("group_size", 0)) or P
+    B, C, H, W = data.shape
+
+    ph = jnp.arange(P, dtype=jnp.float32)
+    # channel-group index of each pooled row/col (clipped like the kernel)
+    gh = jnp.clip(jnp.floor(ph * G / P).astype(jnp.int32), 0, G - 1)
+
+    def pool_one(roi):
+        bi = roi[0].astype(jnp.int32)
+        img = lax.dynamic_index_in_dim(data, bi, 0, keepdims=False)
+        start_w = jnp.round(roi[1]) * spatial_scale
+        start_h = jnp.round(roi[2]) * spatial_scale
+        end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        roi_w = jnp.maximum(end_w - start_w, 0.1)
+        roi_h = jnp.maximum(end_h - start_h, 0.1)
+        bin_h, bin_w = roi_h / P, roi_w / P
+
+        hs = jnp.clip(jnp.floor(ph * bin_h + start_h), 0, H).astype(jnp.int32)
+        he = jnp.clip(jnp.ceil((ph + 1) * bin_h + start_h),
+                      0, H).astype(jnp.int32)
+        ws = jnp.clip(jnp.floor(ph * bin_w + start_w), 0, W).astype(jnp.int32)
+        we = jnp.clip(jnp.ceil((ph + 1) * bin_w + start_w),
+                      0, W).astype(jnp.int32)
+        hh = jnp.arange(H)
+        ww = jnp.arange(W)
+        mh = ((hh[None, :] >= hs[:, None])
+              & (hh[None, :] < he[:, None])).astype(data.dtype)   # (P,H)
+        mw = ((ww[None, :] >= ws[:, None])
+              & (ww[None, :] < we[:, None])).astype(data.dtype)   # (P,W)
+
+        grouped = img.reshape(D, G, G, H, W)
+        # pick each bin's channel group with one-hot contractions
+        oh_h = (jnp.arange(G)[None, :] == gh[:, None]).astype(data.dtype)
+        sel = jnp.einsum("dghxy,pg,qh->dpqxy", grouped, oh_h, oh_h)
+        pooled = jnp.einsum("dpqxy,px,qy->dpq", sel, mh, mw)
+        area = (he - hs)[:, None].astype(data.dtype) \
+            * (we - ws)[None, :].astype(data.dtype)
+        empty = (he <= hs)[:, None] | (we <= ws)[None, :]
+        return jnp.where(empty[None], 0.0,
+                         pooled / jnp.maximum(area, 1.0)[None])
+
+    return (jax.vmap(pool_one)(rois),)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",), num_outputs=2)
+def _deformable_psroi_pooling(params, data, rois, *maybe_trans):
+    """Deformable PSROI pooling (reference `src/operator/contrib/
+    deformable_psroi_pooling.cu` DeformablePSROIPoolForwardKernel;
+    Dai et al., Deformable ConvNets). Each bin is shifted by a learned
+    normalized offset (trans * trans_std * roi size) and averaged over
+    sample_per_part^2 bilinear samples. Outputs (output, top_count);
+    top_count (number of valid samples per bin) is hidden in the
+    reference (NumVisibleOutputs=1) and kept as a second output here.
+
+    TPU design: all bins/samples become one static (D,P,P,S,S) bilinear
+    gather per roi, vmapped over rois — no scalar loops.
+    """
+    spatial_scale = params["spatial_scale"]
+    D = int(params["output_dim"])
+    P = int(params["pooled_size"])
+    G = int(params["group_size"])
+    part = int(params.get("part_size", 0)) or P
+    S = int(params.get("sample_per_part", 1))
+    trans_std = params.get("trans_std", 0.0)
+    no_trans = _bool_param(params, "no_trans") or not maybe_trans
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+
+    if no_trans:
+        ncls = 1
+        trans = jnp.zeros((R, 2, part, part), data.dtype)
+    else:
+        trans = maybe_trans[0]
+        ncls = trans.shape[1] // 2
+    ch_per_cls = D // ncls
+    cls_of_ctop = (jnp.arange(D) // ch_per_cls).astype(jnp.int32)
+
+    pidx = jnp.arange(P)
+    gh = jnp.clip((pidx * G // P).astype(jnp.int32), 0, G - 1)
+    part_h = jnp.floor(pidx.astype(jnp.float32) / P * part).astype(jnp.int32)
+    sidx = jnp.arange(S, dtype=jnp.float32)
+
+    def pool_one(roi, tr):
+        bi = roi[0].astype(jnp.int32)
+        img = lax.dynamic_index_in_dim(data, bi, 0, keepdims=False)
+        start_w = jnp.round(roi[1]) * spatial_scale - 0.5
+        start_h = jnp.round(roi[2]) * spatial_scale - 0.5
+        end_w = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        end_h = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        roi_w = jnp.maximum(end_w - start_w, 0.1)
+        roi_h = jnp.maximum(end_h - start_h, 0.1)
+        bin_h, bin_w = roi_h / P, roi_w / P
+        sub_h, sub_w = bin_h / S, bin_w / S
+
+        # per-(class, ph, pw) learned shift
+        tr_g = tr.reshape(ncls, 2, part, part)
+        tx = tr_g[:, 0][:, part_h][:, :, part_h] * trans_std    # (ncls,P,P)
+        ty = tr_g[:, 1][:, part_h][:, :, part_h] * trans_std
+
+        # sample coordinates (ncls,P,P,S,S)
+        wstart = pidx.astype(jnp.float32)[None, None, :] * bin_w \
+            + start_w + tx * roi_w
+        hstart = pidx.astype(jnp.float32)[None, :, None] * bin_h \
+            + start_h + ty * roi_h
+        wcoord = wstart[..., None, None] + sidx[None, None, None, None, :] \
+            * sub_w
+        hcoord = hstart[..., None, None] + sidx[None, None, None, :, None] \
+            * sub_h
+        # kernel rejects with strict <,> so +/-0.5 boundaries are valid
+        valid = ((wcoord >= -0.5) & (wcoord <= W - 0.5)
+                 & (hcoord >= -0.5) & (hcoord <= H - 0.5))
+        wc = jnp.clip(wcoord, 0.0, W - 1.0)
+        hc = jnp.clip(hcoord, 0.0, H - 1.0)
+        x0 = jnp.floor(wc).astype(jnp.int32)
+        y0 = jnp.floor(hc).astype(jnp.int32)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        fx = wc - x0
+        fy = hc - y0
+
+        # per-ctop views of the class-indexed sample grids -> (D,P,P,S,S)
+        def per_ctop(a):
+            return a[cls_of_ctop]
+        x0c, x1c, y0c, y1c = map(per_ctop, (x0, x1, y0, y1))
+        fxc, fyc = per_ctop(fx), per_ctop(fy)
+        validc = per_ctop(valid)
+
+        # each bin reads its own channel (ctop*G+gh)*G+gw
+        c = (jnp.arange(D)[:, None, None] * G + gh[None, :, None]) * G \
+            + gh[None, None, :]                                  # (D,P,P)
+        cb = c[..., None, None]
+        v00 = img[cb, y0c, x0c]
+        v01 = img[cb, y0c, x1c]
+        v10 = img[cb, y1c, x0c]
+        v11 = img[cb, y1c, x1c]
+        val = (v00 * (1 - fxc) * (1 - fyc) + v01 * fxc * (1 - fyc)
+               + v10 * (1 - fxc) * fyc + v11 * fxc * fyc)
+        val = jnp.where(validc, val, 0.0)
+        cnt = jnp.sum(validc, axis=(-1, -2)).astype(data.dtype)  # (D,P,P)
+        out = jnp.where(cnt > 0, jnp.sum(val, axis=(-1, -2))
+                        / jnp.maximum(cnt, 1.0), 0.0)
+        return out, cnt
+
+    out, cnt = jax.vmap(pool_one)(rois, trans)
+    return out, cnt

@@ -1,0 +1,206 @@
+"""PSROIPooling / DeformablePSROIPooling vs scalar numpy oracles that
+transcribe the reference CUDA kernel semantics (psroi_pooling.cu,
+deformable_psroi_pooling.cu)."""
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def psroi_oracle(data, rois, scale, D, P, G):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, D, P, P), np.float32)
+    for n in range(R):
+        bi = int(rois[n, 0])
+        sw = round(rois[n, 1]) * scale
+        sh = round(rois[n, 2]) * scale
+        ew = (round(rois[n, 3]) + 1.0) * scale
+        eh = (round(rois[n, 4]) + 1.0) * scale
+        rw, rh = max(ew - sw, 0.1), max(eh - sh, 0.1)
+        bh, bw = rh / P, rw / P
+        for ctop in range(D):
+            for ph in range(P):
+                for pw in range(P):
+                    hs = min(max(int(math.floor(ph * bh + sh)), 0), H)
+                    he = min(max(int(math.ceil((ph + 1) * bh + sh)), 0), H)
+                    ws = min(max(int(math.floor(pw * bw + sw)), 0), W)
+                    we = min(max(int(math.ceil((pw + 1) * bw + sw)), 0), W)
+                    gw = min(max(int(pw * G / P), 0), G - 1)
+                    gh = min(max(int(ph * G / P), 0), G - 1)
+                    c = (ctop * G + gh) * G + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    region = data[bi, c, hs:he, ws:we]
+                    out[n, ctop, ph, pw] = region.sum() / region.size
+    return out
+
+
+def bilinear(plane, w, h):
+    H, W = plane.shape
+    x0, y0 = int(math.floor(w)), int(math.floor(h))
+    x1, y1 = min(x0 + 1, W - 1), min(y0 + 1, H - 1)
+    fx, fy = w - x0, h - y0
+    return (plane[y0, x0] * (1 - fx) * (1 - fy)
+            + plane[y0, x1] * fx * (1 - fy)
+            + plane[y1, x0] * (1 - fx) * fy
+            + plane[y1, x1] * fx * fy)
+
+
+def dpsroi_oracle(data, rois, trans, scale, D, P, G, part, S, std,
+                  no_trans=False):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    cec = D // ncls
+    out = np.zeros((R, D, P, P), np.float32)
+    cnt = np.zeros((R, D, P, P), np.float32)
+    for n in range(R):
+        bi = int(rois[n, 0])
+        sw = round(rois[n, 1]) * scale - 0.5
+        sh = round(rois[n, 2]) * scale - 0.5
+        ew = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        eh = (round(rois[n, 4]) + 1.0) * scale - 0.5
+        rw, rh = max(ew - sw, 0.1), max(eh - sh, 0.1)
+        bh, bw = rh / P, rw / P
+        sbh, sbw = bh / S, bw / S
+        for ctop in range(D):
+            cls = ctop // cec
+            for ph in range(P):
+                for pw in range(P):
+                    part_h = int(ph / P * part)
+                    part_w = int(pw / P * part)
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[n, cls * 2, part_h, part_w] * std
+                        ty = trans[n, cls * 2 + 1, part_h, part_w] * std
+                    wstart = pw * bw + sw + tx * rw
+                    hstart = ph * bh + sh + ty * rh
+                    gw = min(max(int(pw * G / P), 0), G - 1)
+                    gh = min(max(int(ph * G / P), 0), G - 1)
+                    c = (ctop * G + gh) * G + gw
+                    s, k = 0.0, 0
+                    for ih in range(S):
+                        for iw in range(S):
+                            w = wstart + iw * sbw
+                            h = hstart + ih * sbh
+                            if w < -0.5 or w > W - 0.5 or h < -0.5 \
+                                    or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            s += bilinear(data[bi, c], w, h)
+                            k += 1
+                    out[n, ctop, ph, pw] = 0.0 if k == 0 else s / k
+                    cnt[n, ctop, ph, pw] = k
+    return out, cnt
+
+
+def test_psroi_pooling_vs_oracle():
+    rng = np.random.RandomState(0)
+    D, G, P = 3, 2, 2
+    B, H, W = 2, 12, 16
+    data = rng.randn(B, D * G * G, H, W).astype("f")
+    rois = np.array([[0, 2, 3, 11, 9], [1, 0, 0, 15, 11],
+                     [0, 5, 5, 6, 6], [1, 14, 10, 15, 11]], "f")
+    want = psroi_oracle(data, rois, 0.5, D, P, G)
+    got = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.5,
+        output_dim=D, pooled_size=P, group_size=G).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_psroi_pooling_default_group_size():
+    rng = np.random.RandomState(1)
+    D, P = 2, 3
+    data = rng.randn(1, D * P * P, 10, 10).astype("f")
+    rois = np.array([[0, 1, 1, 8, 8]], "f")
+    want = psroi_oracle(data, rois, 1.0, D, P, P)
+    got = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=D, pooled_size=P).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans():
+    rng = np.random.RandomState(2)
+    D, G, P, S = 2, 2, 2, 2
+    data = rng.randn(2, D * G * G, 9, 11).astype("f")
+    rois = np.array([[0, 1, 1, 8, 7], [1, 0, 2, 10, 8]], "f")
+    want, wcnt = dpsroi_oracle(data, rois, None, 0.5, D, P, G, P, S, 0.0,
+                               no_trans=True)
+    got, cnt = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.5,
+        output_dim=D, pooled_size=P, group_size=G, sample_per_part=S,
+        no_trans=True)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cnt.asnumpy(), wcnt)
+
+
+def test_deformable_psroi_with_trans():
+    rng = np.random.RandomState(3)
+    D, G, P, S, part = 4, 2, 2, 3, 2
+    ncls = 2
+    data = rng.randn(2, D * G * G, 10, 12).astype("f")
+    rois = np.array([[0, 2, 2, 9, 9], [1, 1, 0, 11, 8]], "f")
+    trans = (rng.rand(2, ncls * 2, part, part).astype("f") - 0.5)
+    want, _ = dpsroi_oracle(data, rois, trans, 0.5, D, P, G, part, S, 0.2)
+    got = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=0.5, output_dim=D, pooled_size=P, group_size=G,
+        part_size=part, sample_per_part=S, trans_std=0.2)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_symbol_and_grad():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    out = mx.sym.contrib.DeformablePSROIPooling(
+        data, rois, spatial_scale=1.0, output_dim=2, pooled_size=2,
+        group_size=2, no_trans=True)
+    _, out_shapes, _ = out.infer_shape(data=(1, 8, 6, 6), rois=(3, 5))
+    assert out_shapes[0] == (3, 2, 2, 2)
+    assert out_shapes[1] == (3, 2, 2, 2)  # top_count
+
+    # gradient flows to data through the bilinear samples
+    x = mx.nd.array(np.random.RandomState(4).randn(1, 8, 6, 6).astype("f"))
+    r = mx.nd.array(np.array([[0, 1, 1, 4, 4]], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.contrib.DeformablePSROIPooling(
+            x, r, spatial_scale=1.0, output_dim=2, pooled_size=2,
+            group_size=2, no_trans=True)[0]
+        loss = (y * y).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_no_trans_string_attr_from_json():
+    """Symbol JSON serializes attrs as strings; "False" must parse false."""
+    rng = np.random.RandomState(5)
+    data = rng.randn(1, 8, 6, 6).astype("f")
+    rois = np.array([[0, 1, 1, 4, 4]], "f")
+    trans = (rng.rand(1, 2, 2, 2).astype("f") - 0.5)
+    want = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=2, pooled_size=2, group_size=2,
+        part_size=2, trans_std=0.3, no_trans=False)[0].asnumpy()
+    import json
+    d, r, t = (mx.sym.Variable(n) for n in ("data", "rois", "trans"))
+    out = mx.sym.contrib.DeformablePSROIPooling(
+        d, r, t, spatial_scale=1.0, output_dim=2, pooled_size=2,
+        group_size=2, part_size=2, trans_std=0.3, no_trans=False)
+    loaded = mx.sym.load_json(out.tojson())
+    ex = loaded.bind(mx.cpu(), {"data": mx.nd.array(data),
+                                "rois": mx.nd.array(rois),
+                                "trans": mx.nd.array(trans)})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # offsets actually applied (zero-trans result differs)
+    no_tr = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=2, pooled_size=2, group_size=2,
+        part_size=2, trans_std=0.3, no_trans=True)[0].asnumpy()
+    assert np.abs(want - no_tr).max() > 1e-4
