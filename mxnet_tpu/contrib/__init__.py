@@ -1,2 +1,12 @@
 """Contrib frontend modules (reference python/mxnet/contrib/)."""
-from . import quantization  # noqa: F401
+from ..ndarray import contrib as ndarray
+from ..ndarray import contrib as nd
+from ..symbol import contrib as symbol
+from ..symbol import contrib as sym
+from . import autograd
+from . import tensorboard
+from . import text
+from . import onnx
+from . import io
+from . import quantization
+from . import quantization as quant

@@ -1,0 +1,47 @@
+"""Contrib data iterators (reference contrib/io.py)."""
+from __future__ import annotations
+
+from ..io import DataIter, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a Gluon DataLoader as a Module-style DataIter
+    (reference contrib/io.py:25). Assumes batches of (data, label)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super(DataLoaderIter, self).__init__()
+        self._loader = loader
+        self._iter = iter(self._loader)
+        data, label = next(self._iter)
+        self.batch_size = data.shape[0]
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, dtype)]
+        self._current_batch = None
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def iter_next(self):
+        try:
+            self._current_batch = next(self._iter)
+            return True
+        except StopIteration:
+            self._current_batch = None
+            return False
+
+    def getdata(self):
+        return [self._current_batch[0]]
+
+    def getlabel(self):
+        return [self._current_batch[1]]
+
+    def getpad(self):
+        return 0
+
+    def getindex(self):
+        return None

@@ -1,0 +1,6 @@
+"""ONNX model import (reference python/mxnet/contrib/onnx/).
+
+`import_model(path)` -> (Symbol, arg_params, aux_params). Requires the
+`onnx` package at call time (gated import — this build ships without it).
+"""
+from .import_model import import_model

@@ -1,0 +1,284 @@
+"""ONNX -> Symbol importer (reference contrib/onnx/_import/).
+
+The translation maps each ONNX node to this framework's symbol ops; the
+resulting Symbol traces to one XLA program like any native graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ndarray as nd
+from ... import symbol as sym
+
+__all__ = ["import_model", "GraphProto"]
+
+
+def import_model(model_file):
+    """Import an ONNX model file (reference
+    contrib/onnx/_import/import_model.py:24).
+
+    Returns (sym, arg_params, aux_params).
+    """
+    try:
+        import onnx
+    except ImportError:
+        raise ImportError(
+            "onnx and protobuf need to be installed to import ONNX models. "
+            "This environment ships without them; install `onnx` or export "
+            "the model to the native symbol-JSON + params format instead.")
+    model_proto = onnx.load(model_file)
+    return GraphProto().from_onnx(model_proto.graph)
+
+
+# -- attribute/op translations ----------------------------------------------
+
+def _pad2d(pads):
+    # ONNX pads: [x1b, x2b, x1e, x2e] -> symmetric (ph, pw)
+    if pads is None:
+        return (0, 0)
+    n = len(pads) // 2
+    return tuple(pads[:n])
+
+
+def _conv(attrs, inputs, proto):
+    kernel = tuple(attrs["kernel_shape"])
+    return sym.Convolution(
+        *inputs, kernel=kernel,
+        stride=tuple(attrs.get("strides", (1,) * len(kernel))),
+        dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
+        pad=_pad2d(attrs.get("pads")),
+        num_filter=proto._params[inputs[1].name].shape[0],
+        num_group=attrs.get("group", 1),
+        no_bias=(len(inputs) == 2))
+
+
+def _pool(pool_type):
+    def impl(attrs, inputs, proto):
+        return sym.Pooling(
+            inputs[0], kernel=tuple(attrs["kernel_shape"]),
+            stride=tuple(attrs.get("strides", (1, 1))),
+            pad=_pad2d(attrs.get("pads")), pool_type=pool_type)
+    return impl
+
+
+def _global_pool(pool_type):
+    def impl(attrs, inputs, proto):
+        return sym.Pooling(inputs[0], kernel=(1, 1), global_pool=True,
+                           pool_type=pool_type)
+    return impl
+
+
+def _gemm(attrs, inputs, proto):
+    a, w, b = inputs
+    alpha = attrs.get("alpha", 1.0)
+    trans_a = attrs.get("transA", 0)
+    trans_b = attrs.get("transB", 0)
+    if trans_a:
+        a = sym.transpose(a)
+    if not trans_b:
+        w = sym.transpose(w)
+    units = proto._params[inputs[1].name].shape[0 if trans_b else 1]
+    if alpha != 1.0:
+        a = a * alpha
+    return sym.FullyConnected(a, weight=w, bias=b, num_hidden=units)
+
+
+def _batchnorm(attrs, inputs, proto):
+    return sym.BatchNorm(
+        *inputs, eps=attrs.get("epsilon", 1e-5),
+        momentum=attrs.get("momentum", 0.9),
+        fix_gamma=False, use_global_stats=attrs.get("spatial", 0) == 0)
+
+
+def _activation(act):
+    def impl(attrs, inputs, proto):
+        return sym.Activation(inputs[0], act_type=act)
+    return impl
+
+
+def _elemwise(op):
+    def impl(attrs, inputs, proto):
+        if attrs.get("broadcast", 0):
+            return getattr(sym, "broadcast_" + op)(*inputs)
+        return getattr(sym, op if op != "sub" else "elemwise_sub")(*inputs) \
+            if hasattr(sym, op) else getattr(sym, "elemwise_" + op)(*inputs)
+    return impl
+
+
+def _reshape(attrs, inputs, proto):
+    if len(inputs) == 2:  # shape as initializer input (opset >= 5)
+        shape = tuple(int(i) for i in
+                      proto._params.pop(inputs[1].name).asnumpy())
+        return sym.Reshape(inputs[0], shape=shape)
+    return sym.Reshape(inputs[0], shape=tuple(attrs["shape"]))
+
+
+def _concat(attrs, inputs, proto):
+    return sym.Concat(*inputs, dim=attrs.get("axis", 1))
+
+
+def _dropout(attrs, inputs, proto):
+    return sym.Dropout(inputs[0], p=attrs.get("ratio", 0.5))[0]
+
+
+def _softmax(attrs, inputs, proto):
+    return sym.softmax(inputs[0], axis=attrs.get("axis", 1))
+
+
+def _flatten(attrs, inputs, proto):
+    return sym.Flatten(inputs[0])
+
+
+def _transpose(attrs, inputs, proto):
+    perm = attrs.get("perm")
+    return sym.transpose(inputs[0], axes=tuple(perm)) if perm \
+        else sym.transpose(inputs[0])
+
+
+def _identity(attrs, inputs, proto):
+    return inputs[0]
+
+
+def _leaky(attrs, inputs, proto):
+    return sym.LeakyReLU(inputs[0], act_type="leaky",
+                         slope=attrs.get("alpha", 0.01))
+
+
+def _elu(attrs, inputs, proto):
+    return sym.LeakyReLU(inputs[0], act_type="elu",
+                         slope=attrs.get("alpha", 1.0))
+
+
+def _prelu(attrs, inputs, proto):
+    return sym.LeakyReLU(inputs[0], gamma=inputs[1], act_type="prelu")
+
+
+def _clip(attrs, inputs, proto):
+    return sym.clip(inputs[0], a_min=attrs.get("min", -np.inf),
+                    a_max=attrs.get("max", np.inf))
+
+
+def _matmul(attrs, inputs, proto):
+    return sym.dot(*inputs)
+
+
+def _reduce(op):
+    def impl(attrs, inputs, proto):
+        return getattr(sym, op)(inputs[0],
+                                axis=tuple(attrs.get("axes", ())) or None,
+                                keepdims=attrs.get("keepdims", 1))
+    return impl
+
+
+_CONVERT_MAP = {
+    "Conv": _conv,
+    "Gemm": _gemm,
+    "MatMul": _matmul,
+    "BatchNormalization": _batchnorm,
+    "SpatialBN": _batchnorm,
+    "MaxPool": _pool("max"),
+    "AveragePool": _pool("avg"),
+    "GlobalMaxPool": _global_pool("max"),
+    "GlobalAveragePool": _global_pool("avg"),
+    "Relu": _activation("relu"),
+    "Sigmoid": _activation("sigmoid"),
+    "Tanh": _activation("tanh"),
+    "LeakyRelu": _leaky,
+    "Elu": _elu,
+    "PRelu": _prelu,
+    "Softmax": _softmax,
+    "Add": _elemwise("add"),
+    "Sub": _elemwise("sub"),
+    "Mul": _elemwise("mul"),
+    "Div": _elemwise("div"),
+    "Sum": lambda a, i, p: sym.add_n(*i),
+    "Reshape": _reshape,
+    "Concat": _concat,
+    "Dropout": _dropout,
+    "Flatten": _flatten,
+    "Transpose": _transpose,
+    "Identity": _identity,
+    "Clip": _clip,
+    "ReduceMean": _reduce("mean"),
+    "ReduceSum": _reduce("sum"),
+    "ReduceMax": _reduce("max"),
+    "ReduceMin": _reduce("min"),
+    "Squeeze": lambda a, i, p: sym.squeeze(
+        i[0], axis=tuple(a.get("axes", ())) or None),
+    "Unsqueeze": lambda a, i, p: _unsqueeze(a, i),
+    "Pad": lambda a, i, p: sym.Pad(
+        i[0], mode=a.get("mode", "constant"),
+        pad_width=tuple(a.get("pads", ())),
+        constant_value=a.get("value", 0.0)),
+}
+
+
+def _unsqueeze(attrs, inputs):
+    out = inputs[0]
+    for ax in sorted(attrs["axes"]):
+        out = sym.expand_dims(out, axis=ax)
+    return out
+
+
+class GraphProto(object):
+    """Translate an onnx GraphProto to (Symbol, arg_params, aux_params)
+    (reference contrib/onnx/_import/import_onnx.py:31)."""
+
+    def __init__(self):
+        self._nodes = {}
+        self._params = {}
+
+    def _parse_array(self, tensor_proto):
+        from onnx import numpy_helper
+        return nd.array(np.asarray(numpy_helper.to_array(tensor_proto)))
+
+    def _parse_attr(self, attr_proto):
+        attrs = {}
+        for a in attr_proto:
+            for f in ("f", "i", "s"):
+                if a.HasField(f):
+                    attrs[a.name] = getattr(a, f)
+                    if f == "s":
+                        attrs[a.name] = attrs[a.name].decode("utf-8")
+            for f in ("floats", "ints", "strings"):
+                if list(getattr(a, f)):
+                    attrs[a.name] = tuple(getattr(a, f))
+            for f in ("t", "g", "tensors", "graphs"):
+                if a.HasField(f) if f in ("t", "g") \
+                        else list(getattr(a, f)):
+                    raise NotImplementedError(
+                        "attribute %s with field %s is not supported"
+                        % (a.name, f))
+        return attrs
+
+    def from_onnx(self, graph):
+        # initializers are parameters
+        for init in graph.initializer:
+            self._params[init.name] = self._parse_array(init)
+        for ip in graph.input:
+            name = ip.name
+            if name in self._params:
+                self._nodes[name] = sym.Variable(
+                    name, shape=self._params[name].shape)
+            else:
+                self._nodes[name] = sym.Variable(name)
+        for node in graph.node:
+            op = node.op_type
+            attrs = self._parse_attr(node.attribute)
+            inputs = [self._nodes[i] for i in node.input]
+            if op not in _CONVERT_MAP:
+                raise NotImplementedError(
+                    "ONNX operator %s is not yet supported" % op)
+            out = _CONVERT_MAP[op](attrs, inputs, self)
+            outputs = out if isinstance(out, (list, tuple)) else [out]
+            for k, name in enumerate(node.output):
+                if k < len(outputs):
+                    self._nodes[name] = outputs[k]
+        out_syms = [self._nodes[o.name] for o in graph.output]
+        final = out_syms[0] if len(out_syms) == 1 else sym.Group(out_syms)
+        arg_names = set(final.list_arguments())
+        arg_params = {k: v for k, v in self._params.items()
+                      if k in arg_names}
+        aux_params = {k: v for k, v in self._params.items()
+                      if k not in arg_names}
+        return final, arg_params, aux_params

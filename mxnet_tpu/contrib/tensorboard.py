@@ -1,0 +1,41 @@
+"""TensorBoard metric logging callback (reference contrib/tensorboard.py).
+
+Uses tensorboardX (or tensorboard) SummaryWriter if importable; raises a
+clear ImportError at construction otherwise.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback writing eval metrics as TensorBoard scalars
+    (reference contrib/tensorboard.py:25; pairs with callback.Speedometer).
+
+    Usage: model.fit(..., batch_end_callback=[LogMetricsCallback(logdir)])
+    then `tensorboard --logdir=<logdir>`.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError:
+            try:
+                from tensorboard import SummaryWriter  # legacy dmlc pkg
+            except ImportError:
+                raise ImportError(
+                    "LogMetricsCallback requires tensorboardX (or the "
+                    "legacy dmlc tensorboard package). Install one, or "
+                    "log metrics with mx.callback.Speedometer instead.")
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """Callback to log training speed and metrics in TensorBoard."""
+        if param.eval_metric is None:
+            return
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value)

@@ -1,0 +1,6 @@
+"""Text utilities: vocabulary and token embeddings
+(reference python/mxnet/contrib/text/)."""
+from . import utils
+from . import vocab
+from .vocab import Vocabulary
+from . import embedding

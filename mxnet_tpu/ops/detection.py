@@ -1173,10 +1173,12 @@ def _psroi_pooling(params, data, rois):
               & (ww[None, :] < we[:, None])).astype(data.dtype)   # (P,W)
 
         grouped = img.reshape(D, G, G, H, W)
-        # pick each bin's channel group with one-hot contractions
+        # pick each bin's channel group + masked bin average in ONE
+        # contraction so opt_einsum reduces H/W first and intermediates
+        # stay at (D,G,G,P,P) scale, not (D,P,P,H,W)
         oh_h = (jnp.arange(G)[None, :] == gh[:, None]).astype(data.dtype)
-        sel = jnp.einsum("dghxy,pg,qh->dpqxy", grouped, oh_h, oh_h)
-        pooled = jnp.einsum("dpqxy,px,qy->dpq", sel, mh, mw)
+        pooled = jnp.einsum("dghxy,pg,qh,px,qy->dpq", grouped, oh_h, oh_h,
+                            mh, mw)
         area = (he - hs)[:, None].astype(data.dtype) \
             * (we - ws)[None, :].astype(data.dtype)
         empty = (he <= hs)[:, None] | (we <= ws)[None, :]
@@ -1207,7 +1209,11 @@ def _deformable_psroi_pooling(params, data, rois, *maybe_trans):
     part = int(params.get("part_size", 0)) or P
     S = int(params.get("sample_per_part", 1))
     trans_std = params.get("trans_std", 0.0)
-    no_trans = _bool_param(params, "no_trans") or not maybe_trans
+    no_trans = _bool_param(params, "no_trans")
+    if not no_trans and not maybe_trans:
+        raise ValueError(
+            "DeformablePSROIPooling needs the trans input unless "
+            "no_trans=True (the reference op fails on the missing input)")
     B, C, H, W = data.shape
     R = rois.shape[0]
 

@@ -1,0 +1,148 @@
+"""contrib package tests: text vocab/embedding (reference
+tests/python/unittest/test_contrib_text.py strategy), legacy autograd,
+DataLoaderIter, onnx gating."""
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens_from_str():
+    source_str = " Life is great! \n life is good . \n"
+    counter = text.utils.count_tokens_from_str(source_str, to_lower=True)
+    assert counter["life"] == 2 and counter["is"] == 2
+    assert counter["great!"] == 1
+
+
+def test_vocabulary_indexing():
+    counter = Counter(["a", "b", "b", "c", "c", "c", "some_word$"])
+    v = text.vocab.Vocabulary(counter, most_freq_count=None, min_freq=1,
+                              unknown_token="<unk>",
+                              reserved_tokens=["<pad>"])
+    assert len(v) == 6
+    assert v.token_to_idx["<unk>"] == 0
+    assert v.token_to_idx["<pad>"] == 1
+    # by decreasing frequency
+    assert v.idx_to_token[2] == "c"
+    assert v.to_indices("c") == 2
+    assert v.to_indices(["c", "unknown!"]) == [2, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "c"]
+    with pytest.raises(ValueError):
+        v.to_tokens(100)
+    # most_freq_count / min_freq thresholds
+    v2 = text.vocab.Vocabulary(counter, most_freq_count=2, min_freq=2)
+    assert len(v2) == 3  # unk + c + b
+
+
+def test_custom_embedding_and_lookup(tmp_path):
+    path = tmp_path / "emb.txt"
+    path.write_text("a 0.1 0.2 0.3\nb 1.0 2.0 3.0\n<unk> 9 9 9\n")
+    emb = text.embedding.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("b").asnumpy(),
+                               [1, 2, 3])
+    # unknown token vector loaded from the file
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("zzz").asnumpy(),
+                               [9, 9, 9])
+    vecs = emb.get_vecs_by_tokens(["a", "b"])
+    assert vecs.shape == (2, 3)
+    assert "a" in emb and "zzz" not in emb
+    emb.update_token_vectors("a", mx.nd.array(np.array([7., 8., 9.], "f")))
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("a").asnumpy(),
+                               [7, 8, 9])
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    path = tmp_path / "emb.txt"
+    path.write_text("a 1 1\nb 2 2\nc 3 3\n")
+    counter = Counter(["a", "c", "c", "d"])
+    v = text.vocab.Vocabulary(counter)
+    emb = text.embedding.CustomEmbedding(str(path), vocabulary=v)
+    assert len(emb) == len(v)
+    assert emb.idx_to_vec.shape == (len(v), 2)
+    # c indexed within vocab; d missing from file -> zeros
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("c").asnumpy(), [3, 3])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("d").asnumpy(), [0, 0])
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "e1.txt"
+    p1.write_text("a 1 1\nb 2 2\n")
+    p2 = tmp_path / "e2.txt"
+    p2.write_text("a 10 11\nc 12 13\n")
+    v = text.vocab.Vocabulary(Counter(["a", "b", "c"]))
+    comp = text.embedding.CompositeEmbedding(
+        v, [text.embedding.CustomEmbedding(str(p1)),
+            text.embedding.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 4
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("a").asnumpy(), [1, 1, 10, 11])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("b").asnumpy()[:2], [2, 2])
+
+
+def test_embedding_registry():
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        text.embedding.create("not_an_embedding")
+    # air-gapped: missing pretrained file raises informative IOError
+    with pytest.raises(IOError):
+        text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt",
+                              embedding_root="/nonexistent")
+
+
+def test_contrib_autograd_grad_and_loss():
+    from mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad_and_loss
+    def f(x):
+        return x * x
+
+    x = mx.nd.array(np.array([1., 2., 3.], "f"))
+    grads, out = f(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2, 4, 6])
+    np.testing.assert_allclose(out.asnumpy(), [1, 4, 9])
+
+    g = cag.grad(lambda x: mx.nd.sum(x * 3))
+    np.testing.assert_allclose(g(x)[0].asnumpy(), 3.0)
+
+
+def test_contrib_autograd_sections():
+    from mxnet_tpu.contrib import autograd as cag
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    with cag.train_section():
+        y = x * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_dataloader_iter():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    X = np.random.rand(20, 4).astype("f")
+    y = np.arange(20, dtype="f")
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    it = DataLoaderIter(loader)
+    assert it.batch_size == 5
+    n = 0
+    for batch in it:
+        n += 1
+        assert batch.data[0].shape == (5, 4)
+    assert n == 4
+
+
+def test_onnx_gated():
+    with pytest.raises(ImportError, match="onnx"):
+        mx.contrib.onnx.import_model("/tmp/nonexistent.onnx")
