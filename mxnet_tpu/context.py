@@ -75,12 +75,15 @@ class Context:
         default backend, falling back to host devices when no accelerator is
         attached (CPU test mode).
         """
+        # local_devices only: under jax.distributed, jax.devices() is the
+        # GLOBAL list and would resolve to another process's
+        # (non-addressable) device
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            devs = _local_cpu_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         devs = _accelerator_devices()
         if not devs:
-            devs = jax.devices("cpu")
+            devs = _local_cpu_devices()
         return devs[self.device_id % len(devs)]
 
     def empty_cache(self):
@@ -89,10 +92,20 @@ class Context:
 
 def _accelerator_devices():
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]
+
+
+def _local_cpu_devices():
+    """This process's cpu devices. The default backend may be an
+    accelerator, so query the cpu backend explicitly — never the global
+    jax.devices('cpu') list, whose head belongs to process 0."""
+    try:
+        return jax.local_devices(backend="cpu")
+    except RuntimeError:
+        return jax.devices("cpu")
 
 
 def cpu(device_id=0):

@@ -33,11 +33,18 @@ def import_model(model_file):
 # -- attribute/op translations ----------------------------------------------
 
 def _pad2d(pads):
-    # ONNX pads: [x1b, x2b, x1e, x2e] -> symmetric (ph, pw)
+    # ONNX pads: [x1_begin, x2_begin, x1_end, x2_end]; conv/pool take one
+    # symmetric (ph, pw) — asymmetric padding must not be dropped silently
     if pads is None:
         return (0, 0)
     n = len(pads) // 2
-    return tuple(pads[:n])
+    begins, ends = tuple(pads[:n]), tuple(pads[n:])
+    if begins != ends:
+        raise NotImplementedError(
+            "asymmetric ONNX pads %s are not supported; insert an "
+            "explicit Pad node or re-export with symmetric padding"
+            % (pads,))
+    return begins
 
 
 def _conv(attrs, inputs, proto):
@@ -69,8 +76,10 @@ def _global_pool(pool_type):
 
 
 def _gemm(attrs, inputs, proto):
-    a, w, b = inputs
+    a, w = inputs[0], inputs[1]
+    b = inputs[2] if len(inputs) > 2 else None
     alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
     trans_a = attrs.get("transA", 0)
     trans_b = attrs.get("transB", 0)
     if trans_a:
@@ -80,6 +89,11 @@ def _gemm(attrs, inputs, proto):
     units = proto._params[inputs[1].name].shape[0 if trans_b else 1]
     if alpha != 1.0:
         a = a * alpha
+    if b is None or beta == 0.0:
+        return sym.FullyConnected(a, weight=w, num_hidden=units,
+                                  no_bias=True)
+    if beta != 1.0:
+        b = b * beta
     return sym.FullyConnected(a, weight=w, bias=b, num_hidden=units)
 
 
@@ -208,9 +222,18 @@ _CONVERT_MAP = {
     "Unsqueeze": lambda a, i, p: _unsqueeze(a, i),
     "Pad": lambda a, i, p: sym.Pad(
         i[0], mode=a.get("mode", "constant"),
-        pad_width=tuple(a.get("pads", ())),
+        pad_width=_onnx_pads_to_pad_width(a.get("pads", ())),
         constant_value=a.get("value", 0.0)),
 }
+
+
+def _onnx_pads_to_pad_width(pads):
+    """ONNX pads [b0..bn, e0..en] -> interleaved (b0, e0, b1, e1, ...)."""
+    n = len(pads) // 2
+    out = []
+    for k in range(n):
+        out.extend((pads[k], pads[n + k]))
+    return tuple(out)
 
 
 def _unsqueeze(attrs, inputs):
@@ -262,6 +285,10 @@ class GraphProto(object):
                     name, shape=self._params[name].shape)
             else:
                 self._nodes[name] = sym.Variable(name)
+        # since ONNX IR v4 initializers need not appear in graph.input
+        for name, arr in self._params.items():
+            if name not in self._nodes:
+                self._nodes[name] = sym.Variable(name, shape=arr.shape)
         for node in graph.node:
             op = node.op_type
             attrs = self._parse_attr(node.attribute)

@@ -45,10 +45,13 @@ def allreduce_nd(nd):
     """Sum an NDArray across processes (BSP dist_sync semantics)."""
     if jax.process_count() == 1:
         return nd
+    import numpy as np
     from jax.experimental import multihost_utils
     from ..ndarray.ndarray import NDArray
-    summed = multihost_utils.process_allgather(nd._data).sum(axis=0)
-    return NDArray(summed, nd.ctx)
+    # allgather the host value: NDArray buffers are committed to an
+    # explicit local device, which process_allgather cannot re-shard
+    gathered = multihost_utils.process_allgather(np.asarray(nd._data))
+    return NDArray(gathered.sum(axis=0), ctx=nd.context)
 
 
 def barrier():

@@ -17,10 +17,17 @@ __all__ = ["seed", "next_key"]
 
 
 class _RandState(threading.local):
+    # key creation is lazy: touching the PRNG at import time would
+    # initialise the XLA backend before jax.distributed.initialize can run
+    # (multi-process workers must import the package first)
     def __init__(self):
         super().__init__()
-        self.key = jax.random.PRNGKey(0)
+        self.key = None
         self.override = None
+
+    def ensure(self):
+        if self.key is None:
+            self.key = jax.random.PRNGKey(0)
 
 
 _STATE = _RandState()
@@ -37,11 +44,13 @@ def next_key(ctx=None):
     if _STATE.override is not None:
         _STATE.override, sub = jax.random.split(_STATE.override)
         return sub
+    _STATE.ensure()
     _STATE.key, sub = jax.random.split(_STATE.key)
     return sub
 
 
 def get_key():
+    _STATE.ensure()
     return _STATE.key
 
 

@@ -1,0 +1,140 @@
+"""Tools tests: im2rec list+rec round trip, rec2idx, parse_log."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+ENV = dict(os.environ, PYTHONPATH=os.path.join(TOOLS, ".."))
+
+
+def _make_image_tree(root):
+    from mxnet_tpu.image import codec
+    rng = np.random.RandomState(0)
+    for cls in ["cat", "dog"]:
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        for i in range(3):
+            img = (rng.rand(12, 14, 3) * 255).astype("uint8")
+            buf = codec.imencode(img, ".jpg", quality=95)
+            with open(os.path.join(root, cls, "%d.jpg" % i), "wb") as f:
+                f.write(buf)
+
+
+def _run(script, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, script)] + list(args),
+        capture_output=True, text=True, env=ENV)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    root = str(tmp_path / "imgs")
+    _make_image_tree(root)
+    prefix = str(tmp_path / "data")
+    r = _run("im2rec.py", prefix, root, "--list", "--recursive")
+    assert r.returncode == 0, r.stderr
+    lst = prefix + ".lst"
+    assert os.path.exists(lst)
+    lines = open(lst).read().strip().split("\n")
+    assert len(lines) == 6
+    labels = {float(l.split("\t")[1]) for l in lines}
+    assert labels == {0.0, 1.0}
+
+    r = _run("im2rec.py", prefix, root)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec") and os.path.exists(
+        prefix + ".idx")
+
+    # records decode back to images with matching labels
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    n = 0
+    for line in lines:
+        idx = int(line.split("\t")[0])
+        header, img = recordio.unpack_img(rec.read_idx(idx))
+        assert img.shape == (12, 14, 3)
+        assert float(header.label) in (0.0, 1.0)
+        n += 1
+    assert n == 6
+    rec.close()
+
+
+def test_rec2idx(tmp_path):
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "x.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "orig.idx"), rec_path, "w")
+    for i in range(5):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), b"payload%d" % i))
+    w.close()
+    r = _run("rec2idx.py", rec_path, str(tmp_path / "rebuilt.idx"))
+    assert r.returncode == 0, r.stderr
+    orig = open(str(tmp_path / "orig.idx")).read()
+    rebuilt = open(str(tmp_path / "rebuilt.idx")).read()
+    assert orig == rebuilt
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.5\n"
+        "INFO Epoch[0] Time cost=10.0\n"
+        "INFO Epoch[0] Validation-accuracy=0.55\n"
+        "INFO Epoch[1] Train-accuracy=0.8\n"
+        "INFO Epoch[1] Time cost=9.0\n"
+        "INFO Epoch[1] Validation-accuracy=0.75\n")
+    r = _run("parse_log.py", str(log))
+    assert r.returncode == 0, r.stderr
+    assert "| epoch |" in r.stdout
+    assert "0.800000" in r.stdout and "0.750000" in r.stdout
+    r = _run("parse_log.py", str(log), "--format", "none")
+    assert "train-accuracy" in r.stdout
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['DMLC_WORKER_ID'],"
+        " 'of', os.environ['DMLC_NUM_WORKER'])\n")
+    r = _run("launch.py", "-n", "2", sys.executable, str(script))
+    assert r.returncode == 0, r.stderr
+
+
+def test_launch_dist_sync_kvstore(tmp_path):
+    """2-process dist_sync consistency over the local launcher — the
+    reference's tests/nightly/dist_sync_kvstore.py trick of running the
+    real transport on one machine (ci/docker/runtime_functions.sh:551)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os\n"
+        "os.environ.setdefault('PALLAS_AXON_POOL_IPS', '')\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu.parallel import dist\n"
+        "dist.init()\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "rank, nw = kv.rank, kv.num_workers\n"
+        "assert nw == 2, nw\n"
+        "kv.init('w', mx.nd.zeros((3, 4)))\n"
+        "kv.push('w', mx.nd.ones((3, 4)) * (rank + 1))\n"
+        "out = mx.nd.zeros((3, 4))\n"
+        "kv.pull('w', out=out)\n"
+        "np.testing.assert_allclose(out.asnumpy(), 3.0)\n"
+        "kv.barrier()\n"
+        "rid = mx.nd.array(np.array([1], 'f'))\n"
+        "kv.row_sparse_pull('w', out=out, row_ids=rid)\n"
+        "np.testing.assert_allclose(out.asnumpy()[1], 3.0)\n"
+        "np.testing.assert_allclose(out.asnumpy()[0], 0.0)\n"
+        "print('DIST WORKER', rank, 'OK')\n")
+    env = dict(os.environ, PYTHONPATH=os.path.join(TOOLS, ".."))
+    env.pop("JAX_PLATFORMS", None)  # launcher pins cpu itself
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", "2",
+         "--port", "9441", "--", sys.executable, str(worker)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert r.stdout.count("OK") == 2

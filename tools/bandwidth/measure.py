@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Measure kvstore push/pull bandwidth (reference
+tools/bandwidth/measure.py): creates ResNet-sized gradient arrays on each
+device and times aggregate push+pull rounds.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def measure(kv_type, data_mb, num_keys, iters, batch_size):
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(kv_type)
+    per_key = int(data_mb * 1024 * 1024 / 4 / num_keys)
+    shapes = [(per_key,) for _ in range(num_keys)]
+    grads = [mx.nd.ones(s) for s in shapes]
+    outs = [mx.nd.zeros(s) for s in shapes]
+    for i, g in enumerate(grads):
+        kv.init(i, g)
+    # warmup
+    for i, g in enumerate(grads):
+        kv.push(i, g)
+        kv.pull(i, out=outs[i])
+    for o in outs:
+        o.wait_to_read()
+
+    tic = time.time()
+    for _ in range(iters):
+        for i, g in enumerate(grads):
+            kv.push(i, g)
+        for i in range(num_keys):
+            kv.pull(i, out=outs[i])
+    for o in outs:
+        o.wait_to_read()
+    total = time.time() - tic
+    nbytes = data_mb * 1024 * 1024 * 2 * iters  # push + pull
+    print("kvstore=%s keys=%d total=%.1f MB x %d iters" % (
+        kv_type, num_keys, data_mb, iters))
+    print("time %.3f s, goodput %.2f GB/s" % (
+        total, nbytes / total / 1e9))
+    return nbytes / total
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="measure kvstore bandwidth",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--data-mb", type=float, default=100.0,
+                        help="total payload size in MB (~ResNet-50 grads)")
+    parser.add_argument("--num-keys", type=int, default=20)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=32)
+    args = parser.parse_args()
+    measure(args.kv_store, args.data_mb, args.num_keys, args.iters,
+            args.batch_size)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Create RecordIO packs from image folders (reference tools/im2rec.py,
+tools/im2rec.cc). Two modes, same CLI contract as the reference:
+
+  --list  : walk an image root, write a .lst file (index\\tlabel\\tpath)
+  default : read a .lst, encode/augment images into .rec (+ .idx)
+
+Decode/encode rides the framework's native codec (src/image_codec.cc)
+with cv2/PIL fallbacks; records are written through MXIndexedRecordIO so
+the .idx is produced in the same pass.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import random
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np  # noqa: E402
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) tuples; label = folder id when
+    recursive (reference im2rec.py list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        if args.chunks > 1:
+            str_chunk = ".part%03d" % i
+        else:
+            str_chunk = ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    """Yield (index, path, *labels) from a .lst file."""
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                print("lst should have at least has three parts, but only "
+                      "has %s parts for %s" % (line_len, line))
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] \
+                    + [float(i) for i in line[1:-1]]
+            except Exception as e:
+                print("Parsing lst met error for %s, detail: %s"
+                      % (line, e))
+                continue
+            yield item
+
+
+def image_encode(args, i, item, q_out):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import codec, imresize
+    from mxnet_tpu import ndarray as nd
+
+    fullpath = os.path.join(args.root, item[1])
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, np.asarray(item[2:], "float32"),
+                                   item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+
+    if args.pass_through:
+        try:
+            with open(fullpath, "rb") as fin:
+                img = fin.read()
+            s = recordio.pack(header, img)
+            q_out.append((i, s, item))
+        except Exception as e:
+            traceback.print_exc()
+            print("pack_img error:", item[1], e)
+            q_out.append((i, None, item))
+        return
+
+    try:
+        with open(fullpath, "rb") as fin:
+            buf = fin.read()
+        img = codec.imdecode_np(buf, iscolor=args.color)
+    except Exception as e:
+        traceback.print_exc()
+        print("imdecode error:", item[1], e)
+        q_out.append((i, None, item))
+        return
+    if img is None:
+        print("imdecode read blank image for file: %s" % fullpath)
+        q_out.append((i, None, item))
+        return
+    if args.center_crop:
+        if img.shape[0] > img.shape[1]:
+            margin = (img.shape[0] - img.shape[1]) // 2
+            img = img[margin:margin + img.shape[1], :]
+        else:
+            margin = (img.shape[1] - img.shape[0]) // 2
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        if img.shape[0] > img.shape[1]:
+            newsize = (args.resize,
+                       img.shape[0] * args.resize // img.shape[1])
+        else:
+            newsize = (img.shape[1] * args.resize // img.shape[0],
+                       args.resize)
+        img = imresize(nd.array(np.ascontiguousarray(img)),
+                       newsize[0], newsize[1]).asnumpy().astype("uint8")
+
+    try:
+        s = recordio.pack_img(header, img, quality=args.quality,
+                              img_fmt=args.encoding)
+        q_out.append((i, s, item))
+    except Exception as e:
+        traceback.print_exc()
+        print("pack_img error on file: %s" % fullpath, e)
+        q_out.append((i, None, item))
+
+
+def make_record(args, path_in):
+    from mxnet_tpu import recordio
+
+    fname = os.path.basename(path_in)
+    fname_rec = os.path.splitext(fname)[0] + ".rec"
+    fname_idx = os.path.splitext(fname)[0] + ".idx"
+    record = recordio.MXIndexedRecordIO(
+        os.path.join(args.prefix_dir, fname_idx),
+        os.path.join(args.prefix_dir, fname_rec), "w")
+    image_list = list(read_list(path_in))
+    tic = time.time()
+    cnt = 0
+    for i, item in enumerate(image_list):
+        out = []
+        image_encode(args, i, item, out)
+        _, s, it = out[0]
+        if s is not None:
+            record.write_idx(it[0], s)
+        if cnt % 1000 == 0 and cnt > 0:
+            print("time:", time.time() - tic, " count:", cnt)
+            tic = time.time()
+        cnt += 1
+    record.close()
+    print("wrote %d records to %s" % (cnt, fname_rec))
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+        description="Create an image list or make a record database by "
+                    "reading from an image list")
+    parser.add_argument("prefix", help="prefix of input/output lst and "
+                                       "rec files.")
+    parser.add_argument("root", help="path to folder containing images.")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true",
+                        help="If this is set im2rec will create image list(s) "
+                             "by traversing root folder and output to <prefix>.lst. "
+                             "Otherwise im2rec will read <prefix>.lst and create a database at <prefix>.rec")
+    cgroup.add_argument("--exts", nargs="+", default=[".jpeg", ".jpg", ".png"],
+                        help="list of acceptable image extensions.")
+    cgroup.add_argument("--chunks", type=int, default=1,
+                        help="number of chunks.")
+    cgroup.add_argument("--train-ratio", type=float, default=1.0,
+                        help="Ratio of images to use for training.")
+    cgroup.add_argument("--test-ratio", type=float, default=0,
+                        help="Ratio of images to use for testing.")
+    cgroup.add_argument("--recursive", action="store_true",
+                        help="If true recursively walk through subdirs and "
+                             "assign an unique label to images in each folder.")
+    cgroup.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                        help="If this is passed, im2rec will not randomize "
+                             "the image order in <prefix>.lst")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="whether to skip transformation and save image as is")
+    rgroup.add_argument("--resize", type=int, default=0,
+                        help="resize the shorter edge of image to the newsize, "
+                             "original images will be packed by default.")
+    rgroup.add_argument("--center-crop", action="store_true",
+                        help="specify whether to crop the center image to make it rectangular.")
+    rgroup.add_argument("--quality", type=int, default=95,
+                        help="JPEG quality for encoding, 1-100; or PNG compression for encoding, 1-9")
+    rgroup.add_argument("--color", type=int, default=1,
+                        choices=[-1, 0, 1],
+                        help="specify the color mode of the loaded image.")
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"],
+                        help="specify the encoding of the images.")
+    rgroup.add_argument("--pack-label", action="store_true",
+                        help="Whether to also pack multi dimensional label in the record file")
+    args = parser.parse_args()
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    return args
+
+
+def main():
+    args = parse_args()
+    if args.list:
+        make_list(args)
+        return
+    args.prefix_dir = os.path.dirname(args.prefix)
+    files = [os.path.join(args.prefix_dir, f)
+             for f in os.listdir(args.prefix_dir or ".")
+             if f.startswith(os.path.basename(args.prefix))
+             and f.endswith(".lst")]
+    print("Creating .rec file from", files)
+    for f in files:
+        make_record(args, f)
+
+
+if __name__ == "__main__":
+    main()

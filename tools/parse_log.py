@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Parse training logs produced by Module.fit / Speedometer
+(reference tools/parse_log.py): extracts per-epoch train/validation
+metrics and epoch time, printed as markdown or TSV.
+"""
+from __future__ import print_function
+
+import argparse
+import re
+import sys
+
+
+def parse_log(lines, metric_names):
+    res = [re.compile(r".*Epoch\[(\d+)\] Train-" + s + r".*=([.\d]+)")
+           for s in metric_names] \
+        + [re.compile(r".*Epoch\[(\d+)\] Validation-" + s + r".*=([.\d]+)")
+           for s in metric_names] \
+        + [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+    data = {}
+    for l in lines:
+        m = None
+        i = 0
+        for r in res:
+            m = r.match(l)
+            if m is not None:
+                break
+            i += 1
+        if m is None:
+            continue
+        epoch = int(m.groups()[0])
+        val = float(m.groups()[1])
+        if epoch not in data:
+            data[epoch] = [0] * len(res) * 2
+        data[epoch][i * 2] += val
+        data[epoch][i * 2 + 1] += 1
+    return data
+
+
+def format_markdown(data, metric_names):
+    lines = []
+    n = len(metric_names)
+    lines.append("| epoch | "
+                 + " | ".join(["train-" + s for s in metric_names])
+                 + " | " + " | ".join(["val-" + s for s in metric_names])
+                 + " | time |")
+    lines.append("| --- " * (2 * n + 2) + "|")
+    for k, v in sorted(data.items()):
+        cells = []
+        for j in range(2 * n):
+            cells.append("%f" % (v[2 * j] / v[2 * j + 1])
+                         if v[2 * j + 1] else "-")
+        t = "%.1f" % (v[-2] / v[-1]) if v[-1] else "-"
+        lines.append("| %2d | " % (k + 1) + " | ".join(cells)
+                     + " | %s |" % t)
+    return "\n".join(lines)
+
+
+def format_tsv(data, metric_names):
+    n = len(metric_names)
+    lines = ["\t".join(["epoch"]
+                       + ["train-" + s for s in metric_names]
+                       + ["val-" + s for s in metric_names] + ["time"])]
+    for k, v in sorted(data.items()):
+        cells = ["%2d" % (k + 1)]
+        for j in range(2 * n):
+            cells.append("%f" % (v[2 * j] / v[2 * j + 1])
+                         if v[2 * j + 1] else "-")
+        cells.append("%.1f" % (v[-2] / v[-1]) if v[-1] else "-")
+        lines.append("\t".join(cells))
+    return "\n".join(lines)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+        description="Parse mxnet output log")
+    parser.add_argument("logfile", nargs=1, type=str,
+                        help="the log file for parsing")
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"],
+                        help="the format of the parsed output")
+    parser.add_argument("--metric-names", type=str, nargs="+",
+                        default=["accuracy"],
+                        help="names of metrics in log which should be parsed")
+    args = parser.parse_args()
+    with open(args.logfile[0]) as f:
+        lines = f.readlines()
+    data = parse_log(lines, args.metric_names)
+    if args.format == "markdown":
+        print(format_markdown(data, args.metric_names))
+    else:
+        print(format_tsv(data, args.metric_names))
+
+
+if __name__ == "__main__":
+    main()

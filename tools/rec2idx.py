@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Rebuild the .idx for an existing .rec file (reference tools/rec2idx.py).
+
+Scans the RecordIO stream, recording each record's byte offset keyed by
+the record's packed header id.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def make_index(rec_path, idx_path):
+    from mxnet_tpu import recordio
+
+    reader = recordio.MXRecordIO(rec_path, "r")
+    with open(idx_path, "w") as fout:
+        counter = 0
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            try:
+                header, _ = recordio.unpack(item)
+                key = header.id
+            except Exception:
+                key = counter
+            fout.write("%s\t%d\n" % (str(key), pos))
+            counter += 1
+    reader.close()
+    print("wrote %d index entries to %s" % (counter, idx_path))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Make index file from a RecordIO file")
+    parser.add_argument("record", help="path to the .rec file")
+    parser.add_argument("index", help="path to the output .idx file")
+    args = parser.parse_args()
+    make_index(args.record, args.index)
+
+
+if __name__ == "__main__":
+    main()
