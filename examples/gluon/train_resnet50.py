@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Gluon ResNet-50 training (reference example/gluon/image_classification.py
+— BASELINE.json config "Gluon ResNet-50 (hybridize + kvstore)").
+
+Synthetic ImageNet-shaped data by default; hybridizes the model so each
+train step is one compiled XLA program, and syncs gradients through a
+kvstore-backed Trainer (kvstore='tpu'/'device'/'dist_sync').
+"""
+from __future__ import print_function
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--model", default="resnet50_v1")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-batches", type=int, default=30)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--kv-store", default="device",
+                        help="local|device|tpu|dist_sync")
+    parser.add_argument("--ctx", default="tpu", choices=["cpu", "tpu"])
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.tpu() if args.ctx == "tpu" and mx.context.num_tpus() \
+        else mx.cpu()
+    net = getattr(vision, args.model)(classes=args.num_classes)
+    net.initialize(mx.init.Xavier(magnitude=2), ctx=ctx)
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    net.hybridize()
+
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4},
+        kvstore=args.kv_store)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(args.batch_size, 3, args.image_size,
+                             args.image_size).astype("f"), ctx=ctx)
+    y = mx.nd.array(rng.randint(0, args.num_classes,
+                                args.batch_size).astype("f"), ctx=ctx)
+
+    # warmup/compile
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(args.batch_size)
+    mx.nd.waitall()
+
+    tic = time.time()
+    for i in range(args.num_batches):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(args.batch_size)
+    mx.nd.waitall()
+    dt = time.time() - tic
+    print("%s: %.1f img/s (batch %d, %s, kvstore=%s)"
+          % (args.model, args.batch_size * args.num_batches / dt,
+             args.batch_size, args.dtype, args.kv_store))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Inference throughput for model-zoo networks (reference
+example/image-classification/benchmark_score.py — the source of the
+BASELINE.md img/s table)."""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+NETS = {
+    "alexnet": vision.alexnet,
+    "vgg16": vision.vgg16,
+    "resnet18_v1": vision.resnet18_v1,
+    "resnet34_v1": vision.resnet34_v1,
+    "resnet50_v1": vision.resnet50_v1,
+    "resnet101_v1": vision.resnet101_v1,
+    "resnet152_v1": vision.resnet152_v1,
+    "inception_v3": vision.inception_v3,
+    "densenet121": vision.densenet121,
+    "mobilenet1_0": vision.mobilenet1_0,
+    "squeezenet1_0": vision.squeezenet1_0,
+}
+
+
+def score(network, batch_size, ctx, image=224, iters=20):
+    net = NETS[network]()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    size = 299 if network == "inception_v3" else image
+    x = mx.nd.random.uniform(shape=(batch_size, 3, size, size), ctx=ctx)
+    net(x).asnumpy()  # compile
+    t0 = time.time()
+    out = None
+    for _ in range(iters):
+        out = net(x)
+    out.asnumpy()
+    dt = time.time() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--networks", nargs="+", default=["resnet50_v1"],
+                        choices=sorted(NETS), help="networks to score")
+    parser.add_argument("--batch-sizes", nargs="+", type=int, default=[32])
+    parser.add_argument("--ctx", default="tpu", choices=["cpu", "tpu"])
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args()
+    ctx = mx.tpu() if args.ctx == "tpu" and mx.context.num_tpus() \
+        else mx.cpu()
+    for network in args.networks:
+        for b in args.batch_sizes:
+            img_s = score(network, b, ctx, iters=args.iters)
+            print("network: %s, batch %d: %.1f img/s" % (network, b, img_s))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Train an MLP or LeNet on MNIST with the Module API
+(reference example/image-classification/train_mnist.py + common/fit.py).
+
+Uses the real MNIST via mx.test_utils.get_mnist() when present; otherwise
+a synthetic separable dataset with the same shapes, so the script always
+runs. This is BASELINE.json config #1 (MLP-MNIST, Module.fit path).
+"""
+from __future__ import print_function
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def get_mnist_iters(batch_size, num_examples=2000):
+    try:
+        mnist = mx.test_utils.get_mnist()
+        train = mx.io.NDArrayIter(mnist["train_data"], mnist["train_label"],
+                                  batch_size, shuffle=True)
+        val = mx.io.NDArrayIter(mnist["test_data"], mnist["test_label"],
+                                batch_size)
+        return train, val
+    except Exception:
+        logging.info("MNIST unavailable; using synthetic digits")
+        rng = np.random.RandomState(42)
+        protos = rng.rand(10, 1, 28, 28).astype("f")
+        y = rng.randint(0, 10, num_examples)
+        X = protos[y] + rng.randn(num_examples, 1, 28, 28).astype("f") * 0.1
+        n_train = int(num_examples * 0.8)
+        train = mx.io.NDArrayIter(X[:n_train], y[:n_train].astype("f"),
+                                  batch_size, shuffle=True)
+        val = mx.io.NDArrayIter(X[n_train:], y[n_train:].astype("f"),
+                                batch_size)
+        return train, val
+
+
+def mlp_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def lenet_symbol():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    p1 = mx.sym.Pooling(mx.sym.Activation(c1, act_type="tanh"),
+                        pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50)
+    p2 = mx.sym.Pooling(mx.sym.Activation(c2, act_type="tanh"),
+                        pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = mx.sym.Flatten(p2)
+    fc1 = mx.sym.Activation(mx.sym.FullyConnected(f, num_hidden=500),
+                            act_type="tanh")
+    fc2 = mx.sym.FullyConnected(fc1, num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist",
+                                     formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None,
+                        help="checkpoint prefix (enables epoch-end save)")
+    parser.add_argument("--num-examples", type=int, default=2000)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    train, val = get_mnist_iters(args.batch_size, args.num_examples)
+    net = mlp_symbol() if args.network == "mlp" else lenet_symbol()
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    cbs = [mx.callback.Speedometer(args.batch_size, 20)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            kvstore=args.kv_store,
+            batch_end_callback=cbs, epoch_end_callback=epoch_cbs,
+            eval_metric="acc")
+    val.reset()
+    score = mod.score(val, "acc")
+    print("final validation:", score)
+    return dict(score)["accuracy"]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Bucketed LSTM word language model (reference example/rnn/word_lm).
+
+Reads PTB-format text from --data if present, else generates a synthetic
+Markov corpus. BucketingModule compiles one XLA program per bucket length
+(the TPU answer to dynamic sequence lengths, SURVEY.md §7) —
+BASELINE.json config LSTM-PTB.
+"""
+from __future__ import print_function
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def load_corpus(path, max_sentences):
+    if path and os.path.exists(path):
+        with open(path) as f:
+            sentences = [line.split() + ["<eos>"] for line in f]
+        sentences = sentences[:max_sentences]
+        return mx.rnn.encode_sentences(sentences, invalid_label=0,
+                                       start_label=1)
+    logging.info("no corpus at %r; generating synthetic Markov text", path)
+    rng = np.random.RandomState(7)
+    V = 200
+    trans = rng.dirichlet(np.ones(V) * 0.05, size=V)
+    sents = []
+    for _ in range(max_sentences):
+        L = rng.randint(8, 33)
+        s = [int(rng.randint(1, V))]
+        for _ in range(L - 1):
+            s.append(int(rng.choice(V, p=trans[s[-1]])))
+        sents.append(s)
+    return sents, {i: i for i in range(V)}
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="word-level LM with bucketing",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--data", default="./data/ptb.train.txt")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--buckets", nargs="+", type=int,
+                        default=[8, 16, 24, 32])
+    parser.add_argument("--max-sentences", type=int, default=2000)
+    parser.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sentences, vocab = load_corpus(args.data, args.max_sentences)
+    vocab_size = max(max(s) for s in sentences) + 1
+    train_iter = mx.rnn.BucketSentenceIter(
+        sentences, args.batch_size, buckets=args.buckets, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    model = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=train_iter.default_bucket_key,
+        context=ctx)
+    model.fit(
+        train_iter, num_epoch=args.num_epochs, optimizer="adam",
+        optimizer_params={"learning_rate": args.lr},
+        eval_metric=mx.metric.Perplexity(ignore_label=None),
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    train_iter.reset()
+    ppl = model.score(train_iter, mx.metric.Perplexity(ignore_label=None))
+    print("final train perplexity:", ppl)
+    return dict(ppl)["perplexity"]
+
+
+if __name__ == "__main__":
+    main()

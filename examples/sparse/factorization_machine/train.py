@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Factorization machine on sparse one-hot features
+(reference example/sparse/factorization_machine). The wide first-order
+term and the factorized second-order term both read RowSparse-style
+embedding rows; gradients only touch the rows seen in the batch.
+"""
+from __future__ import print_function
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def synth_ctr(rng, n, num_features, active):
+    """Synthetic CTR-ish data: y depends on a hidden pairwise interaction."""
+    w_true = rng.randn(num_features) * 0.5
+    v_true = rng.randn(num_features, 4) * 0.5
+    idx = np.stack([rng.choice(num_features, active, replace=False)
+                    for _ in range(n)])
+    lin = w_true[idx].sum(1)
+    inter = 0.5 * ((v_true[idx].sum(1) ** 2).sum(1)
+                   - (v_true[idx] ** 2).sum((1, 2)))
+    y = (lin + inter > 0).astype("f")
+    return idx.astype("f"), y
+
+
+def fm_symbol(num_features, k, active):
+    data = mx.sym.Variable("data")            # (B, active) feature ids
+    label = mx.sym.Variable("softmax_label")
+    w = mx.sym.Embedding(data, input_dim=num_features, output_dim=1,
+                         name="w1")           # first order
+    v = mx.sym.Embedding(data, input_dim=num_features, output_dim=k,
+                         name="v")            # latent factors
+    lin = mx.sym.sum(mx.sym.Flatten(w), axis=1, keepdims=True)
+    sum_sq = mx.sym.square(mx.sym.sum(v, axis=1))
+    sq_sum = mx.sym.sum(mx.sym.square(v), axis=1)
+    inter = 0.5 * mx.sym.sum(sum_sq - sq_sum, axis=1, keepdims=True)
+    score = lin + inter
+    score = mx.sym.Concat(-score, score, dim=1)  # 2-class logits
+    return mx.sym.SoftmaxOutput(score, label, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-features", type=int, default=1000)
+    parser.add_argument("--active", type=int, default=8,
+                        help="non-zeros per example")
+    parser.add_argument("--factor-size", type=int, default=4)
+    parser.add_argument("--num-examples", type=int, default=4000)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(1)
+    X, y = synth_ctr(rng, args.num_examples, args.num_features, args.active)
+    n_train = int(len(y) * 0.8)
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(X[n_train:], y[n_train:], args.batch_size)
+
+    net = fm_symbol(args.num_features, args.factor_size, args.active)
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    val.reset()
+    score = dict(mod.score(val, "acc"))["accuracy"]
+    print("final val accuracy:", score)
+    return score
+
+
+if __name__ == "__main__":
+    main()

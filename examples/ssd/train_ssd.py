@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Tiny SSD training loop over the MultiBox suite
+(reference example/ssd: multibox_prior -> multibox_target -> loss;
+eval with multibox_detection + NMS). Synthetic colored-box detection
+data keeps it self-contained — BASELINE.json SSD config analog.
+"""
+from __future__ import print_function
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+
+
+def synth_batch(rng, batch, size=64):
+    """Images with one solid box; class = box color channel."""
+    x = np.zeros((batch, 3, size, size), "f")
+    labels = np.zeros((batch, 1, 5), "f")
+    for i in range(batch):
+        cls = rng.randint(0, 2)
+        w, h = rng.randint(16, 32), rng.randint(16, 32)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        x[i, cls, y0:y0 + h, x0:x0 + w] = 1.0
+        labels[i, 0] = [cls, x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + h) / size]
+    return x, labels
+
+
+class TinySSD(gluon.HybridBlock):
+    def __init__(self, num_classes=2, num_anchors=4, **kw):
+        super().__init__(**kw)
+        self.num_classes = num_classes
+        with self.name_scope():
+            self.body = gluon.nn.HybridSequential()
+            for f in (16, 32, 64):
+                self.body.add(gluon.nn.Conv2D(f, 3, padding=1),
+                              gluon.nn.BatchNorm(),
+                              gluon.nn.Activation("relu"),
+                              gluon.nn.MaxPool2D(2))
+            self.cls_head = gluon.nn.Conv2D(
+                num_anchors * (num_classes + 1), 3, padding=1)
+            self.box_head = gluon.nn.Conv2D(num_anchors * 4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        feat = self.body(x)
+        anchors = F.contrib.MultiBoxPrior(
+            feat, sizes=(0.3, 0.5), ratios=(1.0, 2.0, 0.5))
+        cls = self.cls_head(feat).transpose((0, 2, 3, 1)).reshape(
+            (0, -1, self.num_classes + 1))
+        box = self.box_head(feat).transpose((0, 2, 3, 1)).flatten()
+        return anchors, cls, box
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-batches", type=int, default=60)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    net = TinySSD()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.L1Loss()
+
+    for it in range(args.num_batches):
+        xb, yb = synth_batch(rng, args.batch_size)
+        x = mx.nd.array(xb, ctx=ctx)
+        y = mx.nd.array(yb, ctx=ctx)
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            box_target, box_mask, cls_target = mx.nd.contrib.MultiBoxTarget(
+                anchors, y, cls_preds.transpose((0, 2, 1)))
+            l_cls = cls_loss(cls_preds, cls_target)
+            l_box = box_loss(box_preds * box_mask, box_target * box_mask)
+            loss = l_cls + l_box
+        loss.backward()
+        trainer.step(args.batch_size)
+        if it % 20 == 0:
+            logging.info("iter %d loss %.4f", it,
+                         float(loss.mean().asnumpy()))
+
+    # detection eval: decode + NMS
+    xb, yb = synth_batch(rng, 8)
+    anchors, cls_preds, box_preds = net(mx.nd.array(xb, ctx=ctx))
+    probs = mx.nd.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+    det = mx.nd.contrib.MultiBoxDetection(probs, box_preds, anchors,
+                                          nms_threshold=0.45)
+    det_np = det.asnumpy()
+    valid = det_np[det_np[:, :, 0] >= 0]
+    print("detections kept after NMS:", valid.shape[0])
+    hits = 0
+    for i in range(8):
+        rows = det_np[i][det_np[i, :, 0] >= 0]
+        if rows.size and int(rows[0, 0]) == int(yb[i, 0, 0]):
+            hits += 1
+    print("top-1 class agreement on synthetic val: %d/8" % hits)
+    return hits
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Examples smoke tests (tiny shapes, CPU) — each BASELINE.json config's
+script must run end-to-end and learn on its synthetic data."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(rel, *args, timeout=420):
+    env = dict(os.environ,
+               PYTHONPATH=os.path.join(EXAMPLES, ".."))
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, rel)] + list(args),
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def test_train_mnist_mlp():
+    r = _run("image-classification/train_mnist.py", "--num-epochs", "4",
+             "--num-examples", "600", "--batch-size", "50")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final validation" in r.stdout
+
+
+def test_word_lm():
+    r = _run("rnn/word_lm/train.py", "--num-epochs", "1",
+             "--max-sentences", "300", "--batch-size", "25",
+             "--num-hidden", "32", "--num-embed", "16",
+             "--data", "/nonexistent")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final train perplexity" in r.stdout
+
+
+def test_ssd():
+    r = _run("ssd/train_ssd.py", "--num-batches", "30", "--batch-size", "8")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "detections kept after NMS" in r.stdout
+
+
+def test_factorization_machine():
+    r = _run("sparse/factorization_machine/train.py", "--num-epochs", "4",
+             "--num-examples", "1200", "--num-features", "300")
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.strip().split()[-1])
+    assert acc > 0.6, r.stdout
+
+
+def test_wide_deep():
+    r = _run("sparse/wide_deep/train.py", "--num-epochs", "6",
+             "--num-examples", "1200", "--num-sparse", "400")
+    assert r.returncode == 0, r.stderr[-2000:]
+    acc = float(r.stdout.strip().split()[-1])
+    assert acc > 0.7, r.stdout
+
+
+def test_model_parallel_lstm():
+    r = _run("model-parallel/lstm_sharded.py", "--steps", "3",
+             "--seq-len", "8", "--batch-size", "2", "--num-hidden", "32")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sharded LSTM train OK" in r.stdout
+
+
+def test_gluon_resnet_tiny():
+    r = _run("gluon/train_resnet50.py", "--model", "resnet18_v1",
+             "--batch-size", "2", "--image-size", "32",
+             "--num-classes", "10", "--num-batches", "2", "--ctx", "cpu")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "img/s" in r.stdout

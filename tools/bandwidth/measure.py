@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
 
-def measure(kv_type, data_mb, num_keys, iters, batch_size):
+def measure(kv_type, data_mb, num_keys, iters):
     import numpy as np
     import mxnet_tpu as mx
 
@@ -58,10 +58,8 @@ def main():
                         help="total payload size in MB (~ResNet-50 grads)")
     parser.add_argument("--num-keys", type=int, default=20)
     parser.add_argument("--iters", type=int, default=10)
-    parser.add_argument("--batch-size", type=int, default=32)
     args = parser.parse_args()
-    measure(args.kv_store, args.data_mb, args.num_keys, args.iters,
-            args.batch_size)
+    measure(args.kv_store, args.data_mb, args.num_keys, args.iters)
 
 
 if __name__ == "__main__":

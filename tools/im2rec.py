@@ -188,19 +188,21 @@ def make_record(args, path_in):
         os.path.join(args.prefix_dir, fname_rec), "w")
     image_list = list(read_list(path_in))
     tic = time.time()
-    cnt = 0
+    cnt = written = 0
     for i, item in enumerate(image_list):
         out = []
         image_encode(args, i, item, out)
         _, s, it = out[0]
         if s is not None:
             record.write_idx(it[0], s)
+            written += 1
         if cnt % 1000 == 0 and cnt > 0:
             print("time:", time.time() - tic, " count:", cnt)
             tic = time.time()
         cnt += 1
     record.close()
-    print("wrote %d records to %s" % (cnt, fname_rec))
+    print("wrote %d records to %s (%d of %d inputs)"
+          % (written, fname_rec, written, cnt))
 
 
 def parse_args():

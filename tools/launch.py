@@ -28,11 +28,12 @@ def launch_local(args, command):
     procs = []
     env_base = dict(os.environ)
     coordinator = args.coordinator or "127.0.0.1:%d" % args.port
+    total = args.num_workers * args.num_hosts
     for r in range(args.num_workers):
         env = dict(env_base)
         env["MX_COORDINATOR"] = coordinator
-        env["DMLC_NUM_WORKER"] = str(args.num_workers)
-        env["DMLC_WORKER_ID"] = str(r)
+        env["DMLC_NUM_WORKER"] = str(total)
+        env["DMLC_WORKER_ID"] = str(args.host_rank * args.num_workers + r)
         env["DMLC_ROLE"] = "worker"
         # each local worker needs its own devices; a single-client TPU
         # tunnel cannot be shared, so local mode forces CPU unless
@@ -75,6 +76,11 @@ def main():
     parser.add_argument("--platform", type=str, default=None,
                         help="JAX_PLATFORMS for workers (default cpu; "
                              "local workers cannot share one TPU tunnel)")
+    parser.add_argument("--num-hosts", type=int, default=1,
+                        help="total hosts running this command")
+    parser.add_argument("--host-rank", type=int, default=0,
+                        help="this host's index in [0, num-hosts); worker "
+                             "ranks are offset by host-rank * num-workers")
     parser.add_argument("command", nargs="+",
                         help="command for launching the program")
     args, unknown = parser.parse_known_args()
