@@ -19,5 +19,6 @@ from . import linalg_ops    # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import detection     # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import compat_ops    # noqa: F401
 
 __all__ = ["register", "get_op", "has_op", "list_ops", "Operator", "invoke"]

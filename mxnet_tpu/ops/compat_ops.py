@@ -1,0 +1,238 @@
+"""Registry-completeness ops: legacy v1 aliases, sparse/scatter helpers,
+image tensor ops, extra samplers, and graph-plumbing identities.
+
+These close the gap between the reference's full NNVM registry
+(192 NNVM_REGISTER_OP + 48 legacy ops) and this framework's op table.
+`_backward_*` entries are deliberately absent everywhere: gradients come
+from jax.grad over the forward lowerings, not from hand-registered
+backward kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import dtype_np
+from .registry import register, alias, get_op
+
+
+# -- graph-plumbing identities (reference src/operator/tensor/
+#    elemwise_unary_op_basic.cc, src/operator/cross_device_copy.cc) --------
+@register("_copyto")
+def _copyto(params, x):
+    """Device copy; XLA handles placement, so this is identity."""
+    return (x,)
+
+
+@register("_CrossDeviceCopy")
+def _cross_device_copy(params, x):
+    """Reference PlaceDevice pass inserts these at ctx-group edges
+    (graph_executor.cc:406); sharding annotations replace them here."""
+    return (x,)
+
+
+@register("_grad_add")
+def _grad_add(params, a, b):
+    """Gradient accumulation add (kAddTo lowering in grad aggregation)."""
+    return (a + b,)
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(params, lhs, rhs):
+    """Identity on lhs with rhs's storage attrs (sparse plumbing)."""
+    return (lhs,)
+
+
+# -- legacy v1 ops (reference convolution_v1.cc, roi_pooling_v1? etc.):
+#    same math as the modern ops, kept as aliases for old model JSON -------
+alias("Convolution", "Convolution_v1")
+alias("Pooling", "Pooling_v1")
+alias("BatchNorm", "CuDNNBatchNorm")
+alias("ROIPooling", "ROIPooling_v1")
+
+
+# -- sparse storage ops (reference tensor/cast_storage-inl.h,
+#    sparse_retain, square_sum). Dense TPU layout: stype is metadata, the
+#    math is identical (SURVEY.md §7 hard part 3). -------------------------
+@register("cast_storage")
+def _cast_storage(params, x):
+    return (x,)
+
+
+@register("_sparse_retain", aliases=("sparse_retain",))
+def _sparse_retain_op(params, data, indices):
+    """Keep only the requested rows, zero the rest
+    (reference tensor/sparse_retain.cc)."""
+    idx = indices.astype(jnp.int32)
+    mask = jnp.zeros((data.shape[0],), bool).at[idx].set(True)
+    return (jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)),
+                      data, 0),)
+
+
+@register("_square_sum")
+def _square_sum(params, x):
+    axis = params.get("axis")
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    keepdims = params.get("keepdims", False)
+    return (jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims),)
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(params, lhs, rhs):
+    """Sparse-aware div: only lhs's stored rows are touched in the
+    reference; dense layout divides everywhere (zeros stay zero)."""
+    return (jnp.where(lhs != 0, lhs / rhs, lhs),)
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(params, x):
+    s = params.get("scalar", 0.0)
+    return (jnp.where(x != 0, x - s, x),)
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(params, lhs, rhs):
+    """Functional slice assignment (NDArray __setitem__ lowering,
+    reference tensor/matrix_op.cc _slice_assign)."""
+    begin = tuple(params["begin"])
+    idx = tuple(slice(b, b + s) for b, s in zip(begin, rhs.shape))
+    return (lhs.at[idx].set(rhs),)
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(params, lhs):
+    begin = tuple(params["begin"])
+    end = tuple(params["end"])
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return (lhs.at[idx].set(params.get("scalar", 0.0)),)
+
+
+@register("_sparse_adagrad_update", aliases=("sparse_adagrad_update",),
+          mutate_aux=(2,))
+def _sparse_adagrad_update(params, weight, grad, history):
+    """AdaGrad with row-sparse grads (reference optimizer_op.cc
+    _sparse_adagrad_update): on dense TPU layout all-zero grad rows
+    contribute nothing, matching the row-sparse skip."""
+    lr = params["lr"]
+    eps = params.get("epsilon", 1e-7)
+    rescale = params.get("rescale_grad", 1.0)
+    clip = params.get("clip_gradient", -1.0)
+    wd = params.get("wd", 0.0)
+    g = grad * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    row_nonzero = jnp.any(grad != 0, axis=tuple(range(1, grad.ndim)),
+                          keepdims=True) if grad.ndim > 1 \
+        else (grad != 0)
+    new_hist = history + jnp.where(row_nonzero, jnp.square(g), 0.0)
+    upd = lr * (g / (jnp.sqrt(new_hist) + eps) + wd * weight)
+    new_w = weight - jnp.where(row_nonzero, upd, 0.0)
+    return (new_w, new_hist)
+
+
+# -- SparseEmbedding (reference src/operator/tensor/indexing_op.cc
+#    _contrib_SparseEmbedding): same lookup as Embedding; the row-sparse
+#    gradient is an XLA scatter either way ---------------------------------
+@register("_contrib_SparseEmbedding", aliases=("SparseEmbedding",))
+def _sparse_embedding(params, data, weight):
+    emb = get_op("Embedding")
+    return emb.fcompute(params, data, weight)
+
+
+# -- image frontend ops (reference src/operator/image/image_random.cc) ----
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def _image_to_tensor(params, x):
+    """HWC [0,255] -> CHW [0,1] float32 (Gluon vision transforms)."""
+    if x.ndim == 3:
+        out = jnp.transpose(x, (2, 0, 1))
+    else:  # NHWC
+        out = jnp.transpose(x, (0, 3, 1, 2))
+    return (out.astype(jnp.float32) / 255.0,)
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def _image_normalize(params, x):
+    """(x - mean) / std per channel on CHW/NCHW float input."""
+    mean = jnp.asarray(params.get("mean", (0.0,)), x.dtype)
+    std = jnp.asarray(params.get("std", (1.0,)), x.dtype)
+    shape = (-1, 1, 1)
+    if x.ndim == 4:
+        shape = (1, -1, 1, 1)
+    return ((x - mean.reshape(shape)) / std.reshape(shape),)
+
+
+# -- negative binomial multisamplers (reference random/multisample_op.cc) --
+def _nb_sample(key, k, p, shape, dt):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    kk = k.reshape(k.shape + (1,) * (len(shape) - k.ndim))
+    pp = p.reshape(p.shape + (1,) * (len(shape) - p.ndim))
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, kk, shape) * (1.0 - pp) / pp
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_sample_negative_binomial", need_rng=True)
+def _sample_negative_binomial(params, k, p):
+    shape = params.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    out_shape = k.shape + tuple(shape)
+    return (_nb_sample(params["_rng_key"], k, p, out_shape,
+                       dtype_np(params.get("dtype") or "float32")),)
+
+
+@register("_sample_generalized_negative_binomial", need_rng=True)
+def _sample_gen_negative_binomial(params, mu, alpha):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate."""
+    shape = params.get("shape", ())
+    if isinstance(shape, int):
+        shape = (shape,)
+    out_shape = mu.shape + tuple(shape)
+    dt = dtype_np(params.get("dtype") or "float32")
+    key = params["_rng_key"]
+    mm = mu.reshape(mu.shape + (1,) * (len(out_shape) - mu.ndim))
+    aa = alpha.reshape(alpha.shape + (1,) * (len(out_shape) - alpha.ndim))
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / jnp.maximum(aa, 1e-8), out_shape) \
+        * mm * aa
+    return (jax.random.poisson(k2, lam, out_shape).astype(dt),)
+
+
+# -- IdentityAttachKLSparseReg (reference
+#    identity_attach_KL_sparse_reg-inl.h): identity forward; a KL
+#    sparseness penalty rides the gradient, with an aux moving average
+#    of the mean activation -----------------------------------------------
+@register("IdentityAttachKLSparseReg", mutate_aux=(1,),
+          need_train_flag=True)
+def _identity_attach_kl_sparse_reg(params, data, moving_avg):
+    rho = params.get("sparseness_target", 0.1)
+    momentum = params.get("momentum", 0.9)
+    is_train = params.get("_is_train", False)
+    # forward: identity; aux tracks the batch-mean activation
+    if is_train:
+        avg = jnp.mean(data, axis=0)
+        new_avg = momentum * moving_avg + (1.0 - momentum) * avg
+    else:
+        new_avg = moving_avg
+    # the KL penalty term d/dx [rho*log(rho/rho_hat) + (1-rho)*log(...)]
+    # enters through a custom vjp so autograd sees the reference's
+    # "attach penalty to gradient" behavior
+    penalty = params.get("penalty", 0.001)
+
+    @jax.custom_vjp
+    def _fwd(x):
+        return x
+
+    def _fwd_fwd(x):
+        return x, x
+
+    def _fwd_bwd(x, g):
+        rho_hat = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+        grad_pen = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + grad_pen / x.shape[0],)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return (_fwd(data), new_avg)

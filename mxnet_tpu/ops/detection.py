@@ -1294,3 +1294,172 @@ def _deformable_psroi_pooling(params, data, rois, *maybe_trans):
 
     out, cnt = jax.vmap(pool_one)(rois, trans)
     return out, cnt
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (max-pool variant), ThreeNN, bipartite matching, SigmoidCE, Crop
+# ---------------------------------------------------------------------------
+@register("_contrib_ROIAlign_v2", aliases=("ROIAlign_v2",))
+def _roi_align_v2(params, data, rois):
+    """ROIAlign with per-bin MAX over 2x2 bilinear samples (reference
+    `src/operator/contrib/roi_align_v2-inl.h:44` ROIAlignForwardKernel_v2:
+    samples at 1/3 and 2/3 of each bin, bilinear-interpolates, takes the
+    max). The reference's hidden argmax_x/argmax_y outputs exist only for
+    its handwritten backward; jax.grad differentiates the forward
+    directly, so only the visible output is exposed (graphs composing
+    this op stay single-output like the reference). rois with
+    batch_ind < 0 produce zeros.
+
+    The reference's degenerate-bin micro-stepping (step clamped to 0.01
+    when a bin collapses) is replaced by the fixed 2x2 sample grid — the
+    defined behavior for all non-degenerate bins.
+    """
+    scale = params["spatial_scale"]
+    P_h, P_w = params["pooled_size"] if isinstance(
+        params["pooled_size"], (tuple, list)) else (
+        int(params["pooled_size"]),) * 2
+    B, C, H, W = data.shape
+
+    ph = jnp.arange(P_h, dtype=jnp.float32)
+    pw = jnp.arange(P_w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        img = lax.dynamic_index_in_dim(data, jnp.maximum(bi, 0), 0,
+                                       keepdims=False)
+        sw, sh = roi[1] * scale, roi[2] * scale
+        ew, eh = roi[3] * scale, roi[4] * scale
+        bin_h = (eh - sh) / P_h
+        bin_w = (ew - sw) / P_w
+        hs = jnp.clip(ph * bin_h + sh, 0.0, H - 1.0)
+        he = jnp.clip((ph + 1) * bin_h + sh, 0.0, H - 1.0)
+        ws = jnp.clip(pw * bin_w + sw, 0.0, W - 1.0)
+        we = jnp.clip((pw + 1) * bin_w + sw, 0.0, W - 1.0)
+        empty = (he <= hs)[:, None] | (we <= ws)[None, :]      # (Ph,Pw)
+
+        # sample points at 1/3 and 2/3 of each bin
+        fr = jnp.asarray([1.0 / 3.0, 2.0 / 3.0], jnp.float32)
+        hpts = hs[:, None] + (he - hs)[:, None] * fr[None, :]  # (Ph,2)
+        wpts = ws[:, None] + (we - ws)[:, None] * fr[None, :]  # (Pw,2)
+        hh = hpts[:, None, :, None]                            # (Ph,1,2,1)
+        wwp = wpts[None, :, None, :]                           # (1,Pw,1,2)
+        y0 = jnp.clip(jnp.floor(hh).astype(jnp.int32), 0, H - 1)
+        y1 = jnp.clip(jnp.ceil(hh).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(wwp).astype(jnp.int32), 0, W - 1)
+        x1 = jnp.clip(jnp.ceil(wwp).astype(jnp.int32), 0, W - 1)
+        a = jnp.where(y0 == y1, 0.5, hh - y0)
+        b = jnp.where(x0 == x1, 0.5, wwp - x0)
+        y0b, y1b, x0b, x1b, ab, bb = (
+            jnp.broadcast_to(t, (P_h, P_w, 2, 2))
+            for t in (y0, y1, x0, x1, a, b))
+        v = (img[:, y0b, x0b] * (1 - ab) * (1 - bb)
+             + img[:, y1b, x0b] * ab * (1 - bb)
+             + img[:, y0b, x1b] * (1 - ab) * bb
+             + img[:, y1b, x1b] * ab * bb)                     # (C,Ph,Pw,2,2)
+        maxval = jnp.max(v.reshape(C, P_h, P_w, 4), axis=-1)
+        invalid = empty[None] | (bi < 0)
+        return jnp.where(invalid, 0.0, maxval)
+
+    return (jax.vmap(one_roi)(rois),)
+
+
+@register("_contrib_ThreeNN", aliases=("ThreeNN",), num_outputs=2)
+def _three_nn(params, unknown, known):
+    """3 nearest neighbors in 3D (fork `src/operator/contrib/
+    three_nn-inl.h` ThreeNNKernel): for each unknown point, the squared
+    distances to all known points, sorted ascending, top-3 -> (dist, idx).
+    unknown (B,N,3), known (B,M,3) -> dist (B,N,3) float, idx (B,N,3).
+    """
+    d2 = jnp.sum(
+        (unknown[:, :, None, :] - known[:, None, :, :]) ** 2, axis=-1)
+    neg_top, idx = lax.top_k(-d2, 3)                   # ascending distances
+    return jnp.sqrt(jnp.maximum(-neg_top, 0.0)), idx.astype(unknown.dtype)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          num_outputs=2)
+def _bipartite_matching(params, scores):
+    """Greedy bipartite matching (reference `contrib/bounding_box-inl.h:619`
+    struct bipartite_matching): repeatedly take the best-scoring unmatched
+    (row, col) pair while the score passes `threshold`; emit row->col and
+    col->row assignments (-1 = unmatched). `is_ascend` flips the order,
+    `topk` caps the number of matches.
+
+    TPU design: the data-dependent greedy loop is a lax.fori_loop over at
+    most min(rows, cols) rounds with masked argmax — one compiled program,
+    no host sync.
+    """
+    thresh = params["threshold"]
+    is_ascend = _bool_param(params, "is_ascend")
+    topk = int(params.get("topk", -1))
+    shape = scores.shape
+    R_, C_ = shape[-2], shape[-1]
+    flat = scores.reshape((-1, R_, C_))
+    rounds = min(R_, C_) if topk <= 0 else min(topk, min(R_, C_))
+
+    def one(score):
+        s = -score if is_ascend else score
+        t = -thresh if is_ascend else thresh
+
+        def body(_, st):
+            rm, cm, s_masked = st
+            j = jnp.argmax(s_masked)
+            r, c = j // C_, j % C_
+            ok = s_masked[r, c] > t
+            rm = jnp.where(ok, rm.at[r].set(c.astype(rm.dtype)), rm)
+            cm = jnp.where(ok, cm.at[c].set(r.astype(cm.dtype)), cm)
+            s_masked = jnp.where(
+                ok,
+                s_masked.at[r, :].set(-jnp.inf).at[:, c].set(-jnp.inf),
+                s_masked)
+            return rm, cm, s_masked
+
+        rm0 = jnp.full((R_,), -1.0, scores.dtype)
+        cm0 = jnp.full((C_,), -1.0, scores.dtype)
+        rm, cm, _ = lax.fori_loop(0, rounds, body, (rm0, cm0, s))
+        return rm, cm
+
+    rm, cm = jax.vmap(one)(flat)
+    return (rm.reshape(shape[:-1]),
+            cm.reshape(shape[:-2] + (C_,)))
+
+
+@register("_contrib_SigmoidCrossEntropy", aliases=("SigmoidCrossEntropy",))
+def _sigmoid_cross_entropy(params, data, label):
+    """Per-element sigmoid cross entropy with -1 = ignore (fork
+    `src/operator/contrib/sigmoid_cross_entropy.cu`
+    SigmoidCrossEntropyLossKernel). The reference's loss/loss_sum/count/
+    count_sum outputs are backward-pass internals (NumVisibleOutputs=1);
+    only `out` — the per-row mean loss over valid elements — is exposed.
+    """
+    n = data.shape[0]
+    d2 = data.reshape(n, -1)
+    l2 = label.reshape(n, -1)
+    valid = l2 != -1
+    # numerically-stable -x*(t - (x>=0)) + log(1+exp(x - 2x(x>=0)))
+    pos = (d2 >= 0).astype(d2.dtype)
+    loss = -d2 * (l2 - pos) + jnp.log1p(jnp.exp(d2 - 2 * d2 * pos))
+    loss = jnp.where(valid, loss, 0.0)
+    loss_sum = jnp.sum(loss, axis=1)
+    count_sum = jnp.sum(valid.astype(d2.dtype), axis=1) + 1e-5
+    return (loss_sum / count_sum,)
+
+
+@register("Crop", num_outputs=1)
+def _legacy_crop(params, *inputs):
+    """Legacy Crop op (reference `src/operator/crop.cc`): crop data's
+    spatial dims to h_w (num_args=1) or to crop_like's shape (num_args=2),
+    at `offset` (y, x) or centered when center_crop=True."""
+    data = inputs[0]
+    B, C, H, W = data.shape
+    if len(inputs) > 1:
+        h, w = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        h, w = _tuple_param(params, "h_w", (H, W))
+        h, w = int(h), int(w)
+    if _bool_param(params, "center_crop"):
+        y0, x0 = (H - h) // 2, (W - w) // 2
+    else:
+        oy, ox = _tuple_param(params, "offset", (0, 0))
+        y0, x0 = int(oy), int(ox)
+    return (data[:, :, y0:y0 + h, x0:x0 + w],)

@@ -1,0 +1,176 @@
+"""Tests for registry-completeness ops: ROIAlign, ThreeNN, bipartite
+matching, SigmoidCrossEntropy, legacy Crop, sparse/scatter/image compat
+ops — numpy oracles follow the reference kernels."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_roi_align_vs_oracle():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 3, 10, 12).astype("f")
+    rois = np.array([[0, 1, 1, 8, 7], [1, 0, 0, 11, 9],
+                     [-1, 0, 0, 4, 4]], "f")
+    scale, P = 0.5, 2
+    out = mx.nd.contrib.ROIAlign_v2(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=scale,
+        pooled_size=(P, P)).asnumpy()
+
+    def bilinear(plane, h, w):
+        H, W = plane.shape
+        y0 = min(max(int(math.floor(h)), 0), H - 1)
+        y1 = min(max(int(math.ceil(h)), 0), H - 1)
+        x0 = min(max(int(math.floor(w)), 0), W - 1)
+        x1 = min(max(int(math.ceil(w)), 0), W - 1)
+        a = 0.5 if y0 == y1 else h - y0
+        b = 0.5 if x0 == x1 else w - x0
+        return (plane[y0, x0] * (1 - a) * (1 - b)
+                + plane[y1, x0] * a * (1 - b)
+                + plane[y0, x1] * (1 - a) * b
+                + plane[y1, x1] * a * b)
+
+    # oracle for roi 0, channel 0: 2x2 samples at 1/3, 2/3 of each bin
+    n, c = 0, 0
+    sw, sh, ew, eh = rois[n, 1] * scale, rois[n, 2] * scale, \
+        rois[n, 3] * scale, rois[n, 4] * scale
+    bh, bw = (eh - sh) / P, (ew - sw) / P
+    for ph in range(P):
+        for pw in range(P):
+            hs = min(max(ph * bh + sh, 0), 10 - 1)
+            he = min(max((ph + 1) * bh + sh, 0), 10 - 1)
+            ws = min(max(pw * bw + sw, 0), 12 - 1)
+            we = min(max((pw + 1) * bw + sw, 0), 12 - 1)
+            vals = [bilinear(data[0, 0], hs + (he - hs) * fh,
+                             ws + (we - ws) * fw)
+                    for fh in (1 / 3, 2 / 3) for fw in (1 / 3, 2 / 3)]
+            np.testing.assert_allclose(out[n, c, ph, pw], max(vals),
+                                       rtol=1e-5)
+    # negative batch index -> zeros
+    np.testing.assert_allclose(out[2], 0.0)
+
+
+def test_three_nn():
+    rng = np.random.RandomState(1)
+    unknown = rng.randn(2, 5, 3).astype("f")
+    known = rng.randn(2, 7, 3).astype("f")
+    dist, idx = mx.nd.contrib.ThreeNN(mx.nd.array(unknown),
+                                      mx.nd.array(known))
+    dist, idx = dist.asnumpy(), idx.asnumpy().astype(int)
+    for b in range(2):
+        for n in range(5):
+            d = np.sqrt(((unknown[b, n] - known[b]) ** 2).sum(-1))
+            order = np.argsort(d)[:3]
+            np.testing.assert_allclose(dist[b, n], d[order], rtol=1e-5)
+            assert set(idx[b, n]) == set(order)
+
+
+def test_bipartite_matching():
+    score = np.array([[[0.5, 0.6], [0.8, 0.9], [0.4, 0.1]]], "f")
+    rm, cm = mx.nd.contrib.bipartite_matching(mx.nd.array(score),
+                                              threshold=0.2)
+    # greedy: (1,1)=0.9 first, then (0,0)=0.5 (0.8 col taken... row1 taken)
+    np.testing.assert_allclose(rm.asnumpy(), [[0, 1, -1]])
+    np.testing.assert_allclose(cm.asnumpy(), [[0, 1]])
+    # threshold cuts low scores
+    rm2, _ = mx.nd.contrib.bipartite_matching(mx.nd.array(score),
+                                              threshold=0.7)
+    np.testing.assert_allclose(rm2.asnumpy(), [[-1, 1, -1]])
+
+
+def test_sigmoid_cross_entropy():
+    data = np.array([[0.5, -1.2], [2.0, 0.1]], "f")
+    label = np.array([[1.0, 0.0], [-1.0, 1.0]], "f")
+    out = mx.nd.contrib.SigmoidCrossEntropy(
+        mx.nd.array(data), mx.nd.array(label)).asnumpy()
+
+    def ce(x, t):
+        return -x * (t - (x >= 0)) + np.log1p(np.exp(x - 2 * x * (x >= 0)))
+    # row 0: both valid
+    want0 = (ce(0.5, 1.0) + ce(-1.2, 0.0)) / (2 + 1e-5)
+    np.testing.assert_allclose(out[0], want0, rtol=1e-5)
+    # row 1: first element ignored (-1 label)
+    want1 = ce(0.1, 1.0) / (1 + 1e-5)
+    np.testing.assert_allclose(out[1], want1, rtol=1e-5)
+
+
+def test_legacy_crop():
+    x = mx.nd.array(np.arange(2 * 3 * 6 * 8, dtype="f").reshape(2, 3, 6, 8))
+    out = mx.nd.Crop(x, h_w=(4, 4), offset=(1, 2), num_args=1)
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out.asnumpy(),
+                               x.asnumpy()[:, :, 1:5, 2:6])
+    like = mx.nd.zeros((2, 3, 3, 3))
+    out2 = mx.nd.Crop(x, like, num_args=2, center_crop=True)
+    assert out2.shape == (2, 3, 3, 3)
+
+
+def test_sparse_compat_ops():
+    x = mx.nd.array(np.arange(12, dtype="f").reshape(4, 3))
+    kept = mx.nd.sparse_retain(x, mx.nd.array(np.array([1, 3], "f")))
+    got = kept.asnumpy()
+    np.testing.assert_allclose(got[0], 0)
+    np.testing.assert_allclose(got[1], x.asnumpy()[1])
+    sq = mx.nd._square_sum(x, axis=1)
+    np.testing.assert_allclose(sq.asnumpy(), (x.asnumpy() ** 2).sum(1))
+
+
+def test_sparse_adagrad_update():
+    w = mx.nd.ones((3, 2))
+    g = mx.nd.array(np.array([[1, 1], [0, 0], [2, 2]], "f"))
+    h = mx.nd.zeros((3, 2))
+    new_w = mx.nd.sparse_adagrad_update(w, g, h, lr=0.1)
+    nw, nh = new_w.asnumpy(), h.asnumpy()  # history mutated in place
+    np.testing.assert_allclose(nh[1], 0.0)       # untouched row
+    np.testing.assert_allclose(nw[1], 1.0)
+    assert nw[0, 0] < 1.0 and nh[0, 0] == 1.0
+
+
+def test_image_ops():
+    img = mx.nd.array((np.arange(2 * 3 * 4 * 3) % 255)
+                      .reshape(2, 3, 4, 3).astype("uint8"))
+    t = mx.nd.image_to_tensor(img)
+    assert t.shape == (2, 3, 3, 4)
+    assert float(t.asnumpy().max()) <= 1.0
+    norm = mx.nd.image_normalize(t, mean=(0.5, 0.5, 0.5),
+                                 std=(0.5, 0.5, 0.5))
+    np.testing.assert_allclose(norm.asnumpy(),
+                               (t.asnumpy() - 0.5) / 0.5, rtol=1e-6)
+
+
+def test_negative_binomial_samplers():
+    k = mx.nd.array(np.array([5.0, 20.0], "f"))
+    p = mx.nd.array(np.array([0.5, 0.5], "f"))
+    s = mx.nd._sample_negative_binomial(k, p, shape=(2000,))
+    m = s.asnumpy().mean(axis=1)
+    # mean = k(1-p)/p
+    np.testing.assert_allclose(m, [5.0, 20.0], rtol=0.25)
+    mu = mx.nd.array(np.array([4.0], "f"))
+    alpha = mx.nd.array(np.array([0.25], "f"))
+    s2 = mx.nd._sample_generalized_negative_binomial(mu, alpha,
+                                                     shape=(2000,))
+    np.testing.assert_allclose(s2.asnumpy().mean(), 4.0, rtol=0.25)
+
+
+def test_slice_assign():
+    x = mx.nd.zeros((4, 4))
+    r = mx.nd.ones((2, 2))
+    out = mx.nd._slice_assign(x, r, begin=(1, 1), end=(3, 3))
+    got = out.asnumpy()
+    assert got[1:3, 1:3].sum() == 4 and got.sum() == 4
+
+
+def test_kl_sparse_reg_identity_and_aux():
+    x = mx.nd.array(np.random.RandomState(2).rand(8, 4).astype("f"))
+    aux = mx.nd.zeros((4,))
+    out = mx.nd.IdentityAttachKLSparseReg(x, aux)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+
+
+def test_v1_aliases_exist():
+    for name in ["Convolution_v1", "Pooling_v1", "CuDNNBatchNorm",
+                 "ROIPooling_v1", "_copyto", "_grad_add", "cast_storage",
+                 "_CrossDeviceCopy", "_contrib_SparseEmbedding"]:
+        assert mx.ops.has_op(name), name
