@@ -9,10 +9,8 @@ backward kernels.
 """
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from ..base import dtype_np
 from .registry import register, alias, get_op
@@ -48,7 +46,6 @@ def _identity_with_attr_like_rhs(params, lhs, rhs):
 # -- legacy v1 ops (reference convolution_v1.cc, roi_pooling_v1? etc.):
 #    same math as the modern ops, kept as aliases for old model JSON -------
 alias("Convolution", "Convolution_v1")
-alias("Pooling", "Pooling_v1")
 alias("BatchNorm", "CuDNNBatchNorm")
 alias("ROIPooling", "ROIPooling_v1")
 
@@ -93,20 +90,33 @@ def _scatter_minus_scalar(params, x):
     return (jnp.where(x != 0, x - s, x),)
 
 
+def _assign_index(params, shape):
+    """begin/end/step params -> slice tuple with negatives normalized
+    (reference tensor/matrix_op.cc slice semantics)."""
+    begin = tuple(params["begin"])
+    end = tuple(params["end"])
+    step = tuple(params.get("step", ())) or (1,) * len(begin)
+    idx = []
+    for b, e, s, n in zip(begin, end, step, shape):
+        s = s if s else 1
+        if b is not None and b < 0:
+            b += n
+        if e is not None and e < 0:
+            e += n
+        idx.append(slice(b, e, s))
+    return tuple(idx)
+
+
 @register("_slice_assign", aliases=("_crop_assign",))
 def _slice_assign(params, lhs, rhs):
     """Functional slice assignment (NDArray __setitem__ lowering,
     reference tensor/matrix_op.cc _slice_assign)."""
-    begin = tuple(params["begin"])
-    idx = tuple(slice(b, b + s) for b, s in zip(begin, rhs.shape))
-    return (lhs.at[idx].set(rhs),)
+    return (lhs.at[_assign_index(params, lhs.shape)].set(rhs),)
 
 
 @register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
 def _slice_assign_scalar(params, lhs):
-    begin = tuple(params["begin"])
-    end = tuple(params["end"])
-    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    idx = _assign_index(params, lhs.shape)
     return (lhs.at[idx].set(params.get("scalar", 0.0)),)
 
 
@@ -196,8 +206,10 @@ def _sample_gen_negative_binomial(params, mu, alpha):
     mm = mu.reshape(mu.shape + (1,) * (len(out_shape) - mu.ndim))
     aa = alpha.reshape(alpha.shape + (1,) * (len(out_shape) - alpha.ndim))
     k1, k2 = jax.random.split(key)
-    lam = jax.random.gamma(k1, 1.0 / jnp.maximum(aa, 1e-8), out_shape) \
-        * mm * aa
+    gam = jax.random.gamma(k1, 1.0 / jnp.maximum(aa, 1e-8), out_shape)
+    # alpha == 0 degenerates to Poisson(mu) (see _random_generalized_
+    # negative_binomial in random_ops.py)
+    lam = jnp.where(aa > 0, gam * mm * aa, mm)
     return (jax.random.poisson(k2, lam, out_shape).astype(dt),)
 
 
@@ -211,28 +223,29 @@ def _identity_attach_kl_sparse_reg(params, data, moving_avg):
     rho = params.get("sparseness_target", 0.1)
     momentum = params.get("momentum", 0.9)
     is_train = params.get("_is_train", False)
-    # forward: identity; aux tracks the batch-mean activation
+    # forward: identity; aux tracks the momentum-smoothed mean activation
     if is_train:
         avg = jnp.mean(data, axis=0)
         new_avg = momentum * moving_avg + (1.0 - momentum) * avg
     else:
         new_avg = moving_avg
-    # the KL penalty term d/dx [rho*log(rho/rho_hat) + (1-rho)*log(...)]
-    # enters through a custom vjp so autograd sees the reference's
-    # "attach penalty to gradient" behavior
+    # the KL penalty d/dx [rho*log(rho/rho_hat) + (1-rho)*log(...)] rides
+    # the gradient via a custom vjp, evaluated at the UPDATED moving
+    # average like the reference (identity_attach_KL_sparse_reg-inl.h:108
+    # updates the average, then backward uses it with no 1/N factor)
     penalty = params.get("penalty", 0.001)
+    rho_hat = jnp.clip(new_avg, 1e-6, 1 - 1e-6)
+    grad_pen = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
 
     @jax.custom_vjp
-    def _fwd(x):
+    def _fwd(x, gp):
         return x
 
-    def _fwd_fwd(x):
-        return x, x
+    def _fwd_fwd(x, gp):
+        return x, gp
 
-    def _fwd_bwd(x, g):
-        rho_hat = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
-        grad_pen = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
-        return (g + grad_pen / x.shape[0],)
+    def _fwd_bwd(gp, g):
+        return (g + gp, jnp.zeros_like(gp))
 
     _fwd.defvjp(_fwd_fwd, _fwd_bwd)
-    return (_fwd(data), new_avg)
+    return (_fwd(data, grad_pen), new_avg)

@@ -1395,7 +1395,9 @@ def _bipartite_matching(params, scores):
     shape = scores.shape
     R_, C_ = shape[-2], shape[-1]
     flat = scores.reshape((-1, R_, C_))
-    rounds = min(R_, C_) if topk <= 0 else min(topk, min(R_, C_))
+    # reference breaks only AFTER recording the (topk+1)-th match
+    # (bounding_box-inl.h:641 count++ then `if (count > topk) break`)
+    rounds = min(R_, C_) if topk <= 0 else min(topk + 1, min(R_, C_))
 
     def one(score):
         s = -score if is_ascend else score

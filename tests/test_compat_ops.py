@@ -174,3 +174,23 @@ def test_v1_aliases_exist():
                  "ROIPooling_v1", "_copyto", "_grad_add", "cast_storage",
                  "_CrossDeviceCopy", "_contrib_SparseEmbedding"]:
         assert mx.ops.has_op(name), name
+
+
+def test_kl_sparse_reg_penalty_rides_gradient():
+    x = mx.nd.array(np.random.RandomState(3).rand(8, 4).astype("f"))
+    aux = mx.nd.full((4,), 0.1)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.IdentityAttachKLSparseReg(
+            x, aux, sparseness_target=0.1, penalty=0.01)
+        loss = y.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # d(sum)/dx = 1 + penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat)),
+    # with rho_hat the momentum-UPDATED moving average (training mode),
+    # no 1/N factor (identity_attach_KL_sparse_reg-inl.h:108)
+    rho, penalty, momentum = 0.1, 0.01, 0.9
+    rho_hat = momentum * 0.1 + (1 - momentum) * x.asnumpy().mean(axis=0)
+    want = 1.0 + penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+    np.testing.assert_allclose(g, np.broadcast_to(want, g.shape),
+                               rtol=1e-5)
