@@ -122,6 +122,9 @@ class ImageRecordIterImpl(DataIter):
         self._workers = []
         self.reset()
 
+    def _label_batch_shape(self):
+        return (self.batch_size,)
+
     def _load_index(self):
         if self._use_idx:
             rec = rio.MXIndexedRecordIO(self.idx_path, self.path_imgrec, "r")
@@ -190,7 +193,7 @@ class ImageRecordIterImpl(DataIter):
                 if stop_evt.is_set():
                     return
                 data = np.empty((self.batch_size, c, h, w), np.float32)
-                label = np.empty((self.batch_size,), np.float32)
+                label = np.empty(self._label_batch_shape(), np.float32)
                 pad = 0
                 for j in range(self.batch_size):
                     pos = start + j
@@ -231,3 +234,105 @@ class ImageRecordIterImpl(DataIter):
             raise StopIteration
         data, label, pad = item
         return DataBatch(data=[array(data)], label=[array(label)], pad=pad)
+
+
+class ImageDetRecordIter(ImageRecordIterImpl):
+    """Detection-aware record iterator (reference
+    `src/io/iter_image_det_recordio.cc`, `image_det_aug_default.cc`).
+
+    Label layout per record (image_det_aug_default.cc:254-276):
+    ``[header_width(>=2), object_width(>=5), extra headers...,
+    objects...]`` with each object ``[id, x1, y1, x2, y2, extra...]`` in
+    normalized [0,1] coordinates. Batches emit the flat label padded to
+    ``label_pad_width`` with ``label_pad_value`` (reference defaults -1);
+    when unset, the pad width is scanned from the data like the
+    reference's estimation pass (iter_image_det_recordio.cc:289-331).
+
+    Augmentation: resize to data_shape plus box-aware random mirror
+    (x coordinates flip with the image). The classification iterator's
+    `resize`/`rand_crop` knobs are rejected — box-aware random crop is
+    not implemented.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=0, label_pad_value=-1.0, **kwargs):
+        if kwargs.get("resize") or kwargs.get("rand_crop"):
+            raise NotImplementedError(
+                "ImageDetRecordIter resizes to data_shape; box-aware "
+                "resize/rand_crop augmenters are not implemented")
+        self.label_pad_width = int(label_pad_width)
+        self.label_pad_value = float(label_pad_value)
+        kwargs.setdefault("label_name", "label")
+        super().__init__(path_imgrec=path_imgrec, data_shape=data_shape,
+                         batch_size=batch_size, **kwargs)
+        self.provide_label = [DataDesc(kwargs.get("label_name", "label"),
+                                       (batch_size, self.label_pad_width))]
+
+    def _label_batch_shape(self):
+        return (self.batch_size, self.label_pad_width)
+
+    def _load_index(self):
+        # runs inside base __init__ BEFORE the first reset()/producer, so
+        # the auto-scanned pad width is ready for the first epoch
+        super()._load_index()
+        if not self.label_pad_width:
+            self.label_pad_width = self._scan_max_label_width()
+
+    def _scan_max_label_width(self):
+        rec = (rio.MXIndexedRecordIO(self.idx_path, self.path_imgrec, "r")
+               if self._use_idx else rio.MXRecordIO(self.path_imgrec, "r"))
+        width = 0
+        try:
+            for key in self._keys:
+                if self._use_idx:
+                    s = rec.read_idx(key)
+                else:
+                    rec.seek(key)
+                    s = rec.read()
+                header, _ = rio.unpack(s)
+                width = max(width, np.asarray(header.label).size)
+        finally:
+            rec.close()
+        return max(width, 2)
+
+    def _det_label(self, header):
+        lab = np.asarray(header.label, np.float32).ravel()
+        if lab.size > self.label_pad_width:
+            # reference LOG(FATAL)s when label_pad_width is too small
+            # (iter_image_det_recordio.cc:325-328)
+            raise ValueError(
+                "record label has %d values but label_pad_width is %d"
+                % (lab.size, self.label_pad_width))
+        out = np.full((self.label_pad_width,), self.label_pad_value,
+                      np.float32)
+        out[:lab.size] = lab
+        return out
+
+    def _decode_one(self, rec_handle, key):
+        if self._use_idx:
+            s = rec_handle.read_idx(key)
+        else:
+            rec_handle.seek(key)
+            s = rec_handle.read()
+        header, img_buf = rio.unpack(s)
+        img = imdecode_np(img_buf, iscolor=1, to_rgb=True)
+        c, h, w = self.data_shape
+        if img.shape[:2] != (h, w):
+            import cv2
+            img = cv2.resize(img, (w, h))
+        label = self._det_label(header)
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+            # flip normalized x coords of every object
+            hw = int(label[0])
+            ow = int(label[1])
+            if ow >= 5:
+                p = hw
+                while p + ow <= self.label_pad_width \
+                        and label[p] != self.label_pad_value:
+                    x1, x2 = label[p + 1], label[p + 3]
+                    label[p + 1], label[p + 3] = 1.0 - x2, 1.0 - x1
+                    p += ow
+        chw = np.transpose(img, (2, 0, 1)).astype(np.float32)
+        chw = (chw - self.mean) / self.std
+        return chw, label

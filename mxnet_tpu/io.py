@@ -26,7 +26,7 @@ from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "ImageDetRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -481,3 +481,13 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
     from .image.record_iter import ImageRecordIterImpl
     return ImageRecordIterImpl(path_imgrec=path_imgrec, data_shape=data_shape,
                                batch_size=batch_size, shuffle=shuffle, **kwargs)
+
+
+def ImageDetRecordIter(path_imgrec=None, data_shape=(3, 300, 300),
+                       batch_size=1, **kwargs):
+    """Detection record iterator (reference
+    `src/io/iter_image_det_recordio.cc`); labels are flat padded
+    [header_width, object_width, headers..., objects...] rows."""
+    from .image.record_iter import ImageDetRecordIter as _Impl
+    return _Impl(path_imgrec=path_imgrec, data_shape=data_shape,
+                 batch_size=batch_size, **kwargs)

@@ -85,7 +85,12 @@ class MXRecordIO:
             self.fid.close()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            # interpreter shutdown may have torn down builtins (open);
+            # explicitly-closed handles never hit this
+            pass
 
     def __getstate__(self):
         d = dict(self.__dict__)

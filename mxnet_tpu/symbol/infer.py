@@ -37,6 +37,12 @@ def fill_param_shapes(op_name, params, in_shapes):
         if known is None:
             raise MXNetError("cannot infer shapes for op %s" % op_name)
         return [known if s is None else s for s in in_shapes]
+    if in_shapes[0] is None:
+        # fillers derive parameter shapes from the data shape; with the
+        # data shape itself unknown there is nothing to derive (partial
+        # inference tolerates this, full inference reports it)
+        raise MXNetError("cannot infer shapes for op %s: data shape "
+                         "unknown" % op_name)
     return fn(dict(params, _op_name=op_name), list(in_shapes))
 
 

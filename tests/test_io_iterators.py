@@ -1,0 +1,102 @@
+"""IO iterator suite (reference tests/python/unittest/test_io.py):
+CSVIter, LibSVMIter, MNISTIter, ImageDetRecordIter, NDArrayIter
+last-batch modes."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import codec
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.RandomState(0).rand(20, 4).astype("f")
+    labels = np.arange(20, dtype="f")
+    dpath, lpath = tmp_path / "d.csv", tmp_path / "l.csv"
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels.reshape(-1, 1), delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(dpath), data_shape=(4,),
+                       label_csv=str(lpath), batch_size=5)
+    got = []
+    for batch in it:
+        got.append(batch.data[0].asnumpy())
+    got = np.concatenate(got)
+    np.testing.assert_allclose(got, data, rtol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    path = tmp_path / "d.svm"
+    path.write_text("1 0:0.5 3:1.5\n0 1:2.0\n1 2:3.0 3:4.0\n0 0:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(4,),
+                          batch_size=2)
+    rows = []
+    labels = []
+    for batch in it:
+        rows.append(batch.data[0].asnumpy())
+        labels.append(batch.label[0].asnumpy())
+    rows = np.concatenate(rows)
+    labels = np.concatenate(labels)
+    np.testing.assert_allclose(rows[0], [0.5, 0, 0, 1.5])
+    np.testing.assert_allclose(rows[1], [0, 2.0, 0, 0])
+    np.testing.assert_allclose(labels[:4], [1, 0, 1, 0])
+
+
+def test_mnist_iter(tmp_path):
+    """Synthesize idx-ubyte files in the MNIST format."""
+    rng = np.random.RandomState(1)
+    imgs = (rng.rand(10, 28, 28) * 255).astype(np.uint8)
+    labs = rng.randint(0, 10, 10).astype(np.uint8)
+    img_path = tmp_path / "images-idx3-ubyte"
+    lab_path = tmp_path / "labels-idx1-ubyte"
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">iiii", 2051, 10, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lab_path, "wb") as f:
+        f.write(struct.pack(">ii", 2049, 10))
+        f.write(labs.tobytes())
+    it = mx.io.MNISTIter(image=str(img_path), label=str(lab_path),
+                         batch_size=5, flat=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 1, 28, 28)
+    # values normalized to [0, 1]
+    assert float(batch.data[0].asnumpy().max()) <= 1.0
+
+
+def test_image_det_record_iter(tmp_path):
+    rng = np.random.RandomState(2)
+    rec_path = str(tmp_path / "det.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "det.idx"), rec_path, "w")
+    for i in range(6):
+        img = (rng.rand(20, 24, 3) * 255).astype("uint8")
+        nobj = 1 + i % 2
+        label = [2, 5] + sum(
+            ([float(i % 3), 0.1, 0.2, 0.6, 0.8] for _ in range(nobj)), [])
+        header = recordio.IRHeader(0, np.asarray(label, "f"), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img))
+    w.close()
+
+    it = mx.io.ImageDetRecordIter(path_imgrec=rec_path,
+                                  data_shape=(3, 16, 16), batch_size=3)
+    seen = 0
+    for b in it:
+        seen += 1
+        assert b.data[0].shape == (3, 3, 16, 16)
+        lab = b.label[0].asnumpy()
+        assert lab.shape == (3, 12)  # 2 header + 2 objs x 5
+        assert (lab[:, 0] == 2).all() and (lab[:, 1] == 5).all()
+        # first object's box is valid and normalized
+        assert ((lab[:, 3:7] >= -1) & (lab[:, 3:7] <= 1)).all()
+    assert seen == 2
+
+
+def test_ndarray_iter_last_batch_modes():
+    X = np.arange(25, dtype="f").reshape(25, 1)
+    it = mx.io.NDArrayIter(X, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3 and batches[-1].pad == 5
+    it = mx.io.NDArrayIter(X, batch_size=10, last_batch_handle="discard")
+    assert len(list(it)) == 2

@@ -1,0 +1,120 @@
+"""KVStore single-process semantics (reference
+tests/python/unittest/test_kvstore.py): init/push/pull aggregation over
+device lists, updater hooks, string keys, row_sparse_pull, optimizer
+state save/load."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    np.testing.assert_allclose(A.asnumpy(), x, rtol=1e-5)
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    val = [mx.nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator_device_list():
+    """Pushing a list of values for one key sums them (the reference's
+    multi-device aggregation, comm.h:103)."""
+    kv = init_kv()
+    num_devs = 4
+    devs = [mx.cpu(0)] * num_devs
+    vals = [mx.nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, num_devs)
+
+    kv.push(KEYS, [[mx.nd.ones(SHAPE, ctx=d) * 2.0 for d in devs]] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        check_diff_to_scalar(o, num_devs * 2.0)
+
+
+def test_updater():
+    kv = init_kv()
+
+    def updater(key, recv, local):
+        local += recv
+    kv._set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, 2)
+
+
+def test_string_keys():
+    kv = mx.kv.create("local")
+    kv.init("w0", mx.nd.ones(SHAPE))
+    kv.push("w0", mx.nd.ones(SHAPE) * 3)
+    out = mx.nd.empty(SHAPE)
+    kv.pull("w0", out=out)
+    check_diff_to_scalar(out, 3)
+    # mixing int keys after string keys is an error (reference semantics)
+    with pytest.raises(mx.MXNetError):
+        kv.init(9, mx.nd.ones(SHAPE))
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = mx.nd.array(np.arange(12, dtype="f").reshape(6, 2))
+    kv.init("emb", w)
+    kv.push("emb", w)
+    out = mx.nd.zeros((6, 2))
+    rid = mx.nd.array(np.array([1, 4], "f"))
+    kv.row_sparse_pull("emb", out=out, row_ids=rid)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], w.asnumpy()[1])
+    np.testing.assert_allclose(got[4], w.asnumpy()[4])
+    np.testing.assert_allclose(got[0], 0)
+
+
+def test_set_optimizer_and_states_roundtrip(tmp_path):
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, mx.nd.ones(SHAPE))
+    fname = str(tmp_path / "states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_invalid_kvstore_type():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("no_such_store")
+
+
+def test_double_init_errors():
+    kv = init_kv()
+    with pytest.raises(mx.MXNetError):
+        kv.init(3, mx.nd.zeros(SHAPE))

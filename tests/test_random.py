@@ -1,0 +1,71 @@
+"""RNG tests (reference tests/python/unittest/test_random.py strategy:
+statistical moments, seed determinism, per-distribution sanity — bitwise
+parity with the reference's mshadow RNG is deliberately not a goal,
+SURVEY.md §7 hard part 7)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_seed_determinism():
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_uniform_moments():
+    mx.random.seed(0)
+    x = mx.nd.random.uniform(low=2.0, high=4.0, shape=(40000,)).asnumpy()
+    assert 2.0 <= x.min() and x.max() <= 4.0
+    np.testing.assert_allclose(x.mean(), 3.0, atol=0.05)
+    np.testing.assert_allclose(x.var(), 4.0 / 12.0, atol=0.05)
+
+
+def test_normal_moments():
+    mx.random.seed(1)
+    x = mx.nd.random.normal(loc=1.5, scale=2.0, shape=(40000,)).asnumpy()
+    np.testing.assert_allclose(x.mean(), 1.5, atol=0.06)
+    np.testing.assert_allclose(x.std(), 2.0, atol=0.06)
+
+
+def test_gamma_poisson_exponential():
+    mx.random.seed(2)
+    g = mx.nd.random.gamma(alpha=4.0, beta=0.5, shape=(40000,)).asnumpy()
+    np.testing.assert_allclose(g.mean(), 4.0 * 0.5, rtol=0.05)
+    p = mx.nd.random.poisson(lam=3.0, shape=(40000,)).asnumpy()
+    np.testing.assert_allclose(p.mean(), 3.0, rtol=0.05)
+    e = mx.nd.random.exponential(scale=2.0, shape=(40000,)).asnumpy()
+    np.testing.assert_allclose(e.mean(), 2.0, rtol=0.05)
+
+
+def test_multinomial_distribution():
+    mx.random.seed(3)
+    probs = mx.nd.array(np.array([[0.1, 0.2, 0.7]], "f"))
+    draws = mx.nd.sample_multinomial(probs, shape=(20000,)).asnumpy().ravel()
+    freq = np.bincount(draws.astype(int), minlength=3) / draws.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+
+
+def test_randint_and_shuffle():
+    mx.random.seed(4)
+    r = mx.nd.random.randint(low=0, high=10, shape=(1000,)).asnumpy()
+    assert r.min() >= 0 and r.max() <= 9
+    x = mx.nd.array(np.arange(50, dtype="f"))
+    s = mx.nd.shuffle(x).asnumpy()
+    assert sorted(s.tolist()) == list(range(50))
+    assert not np.allclose(s, np.arange(50))
+
+
+def test_symbolic_random_in_executor():
+    """random symbols inside a bound executor produce fresh draws per
+    forward (the reference's RNG resource semantics)."""
+    x = mx.sym.random_uniform(shape=(64,), name="r")
+    ex = x.bind(mx.cpu(), {})
+    a = ex.forward()[0].asnumpy()
+    b = ex.forward()[0].asnumpy()
+    assert not np.allclose(a, b)
