@@ -457,9 +457,16 @@ class HybridBlock(Block):
     def _forward_impl(self, *args):
         """Eager forward via hybrid_forward with params injected.
 
+        Symbol inputs reroute to the symbolic tracer so export works even
+        for blocks whose hybrid_forward invokes children through
+        `child._forward_impl` (the model-zoo idiom).
+
         Deferred init (reference block.py deferred shape inference): a leaf
         layer with unknown param shapes implements `_infer_shapes(x)`; it
         runs on first forward, after which the params materialise."""
+        from .. import symbol as sym_mod
+        if args and isinstance(args[0], sym_mod.Symbol):
+            return self._symbolic_forward(*args)
         if any(p._deferred_init for p in self._reg_params.values()):
             self._infer_shapes(*args)
             for p in self._reg_params.values():
@@ -472,6 +479,10 @@ class HybridBlock(Block):
         """Override in leaf layers to fill deferred param shapes from input."""
 
     def forward(self, x, *args):
+        from .. import symbol as sym_mod
+        if isinstance(x, sym_mod.Symbol):
+            # child invoked during symbolic tracing (export/_trace_symbol)
+            return self._symbolic_forward(x, *args)
         if self._active:
             try:
                 return self._call_cached(x, *args)
@@ -506,6 +517,9 @@ class HybridBlock(Block):
         return out
 
     def _symbolic_forward(self, *args):
+        """Symbolic analog of _forward_impl: hybrid_forward with the
+        symbol module and param Variables; child blocks invoked inside
+        hybrid_forward route back here via forward()'s Symbol check."""
         params = {k: v.var() for k, v in self._reg_params.items()}
         from .. import symbol as sym_mod
         return self.hybrid_forward(sym_mod, *args, **params)
@@ -549,10 +563,21 @@ class SymbolBlock(HybridBlock):
         self._input_names = [i.name for i in inputs]
         arg_names = set(outputs.list_arguments())
         aux_names = set(outputs.list_auxiliary_states())
+        # param names must stay EXACTLY the symbol's input names (no block
+        # prefix) so exported .params files bind by name
+        from .parameter import Parameter
         for name in outputs.list_inputs():
             if name not in self._input_names:
                 grad_req = "null" if name in aux_names else "write"
-                self.params.get(name, allow_deferred_init=True, grad_req=grad_req)
+                # consult shared params (the params= feature-extractor
+                # idiom) before creating a fresh deferred Parameter
+                existing = self.params._get_impl(name) \
+                    if hasattr(self.params, "_get_impl") else None
+                if existing is not None:
+                    self.params._params[name] = existing
+                elif name not in self.params._params:
+                    self.params._params[name] = Parameter(
+                        name, allow_deferred_init=True, grad_req=grad_req)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):

@@ -22,15 +22,20 @@ class HybridConcurrent(HybridBlock):
         raise RuntimeError("HybridConcurrent dispatches via _forward_impl")
 
     def _forward_impl(self, x):
+        from ... import symbol as sym_mod
+        if isinstance(x, sym_mod.Symbol):
+            return self._symbolic_forward(x)
         from ... import ndarray as F
         outs = [c._forward_impl(x) if isinstance(c, HybridBlock) else c(x)
                 for c in self._children.values()]
         return F.Concat(*outs, dim=self.axis)
 
+    def _symbolic_forward(self, x):
+        from ... import symbol as F
+        outs = [c._symbolic_forward(x) for c in self._children.values()]
+        return F.Concat(*outs, dim=self.axis)
+
 
 class Identity(HybridBlock):
     def hybrid_forward(self, F, x):
-        return x
-
-    def _forward_impl(self, x):
         return x

@@ -39,9 +39,6 @@ class AlexNet(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 def alexnet(pretrained=False, ctx=cpu(), root=None, **kwargs):

@@ -33,9 +33,6 @@ class _DenseLayer(HybridBlock):
     def hybrid_forward(self, F, x):
         return F.Concat(x, self.body._forward_impl(x), dim=1)
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
@@ -84,9 +81,6 @@ class DenseNet(HybridBlock):
         x = self.features._forward_impl(x)
         return self.output._forward_impl(x)
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 # num_init_features, growth_rate, block_config per reference densenet.py

@@ -148,9 +148,6 @@ class Inception3(HybridBlock):
         x = self.features._forward_impl(x)
         return self.output._forward_impl(x)
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 def inception_v3(pretrained=False, ctx=cpu(), **kwargs):

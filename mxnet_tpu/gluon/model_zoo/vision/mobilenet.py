@@ -52,9 +52,6 @@ class LinearBottleneck(HybridBlock):
             out = out + x
         return out
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class MobileNet(HybridBlock):
@@ -82,9 +79,6 @@ class MobileNet(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class MobileNetV2(HybridBlock):
@@ -122,9 +116,6 @@ class MobileNetV2(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=cpu(), root=None, **kwargs):

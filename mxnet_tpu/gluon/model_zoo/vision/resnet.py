@@ -48,11 +48,7 @@ class BasicBlockV1(HybridBlock):
         x = F.Activation(residual + x, act_type="relu")
         return x
 
-    _forward_impl_inner = hybrid_forward
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class BottleneckV1(HybridBlock):
@@ -85,9 +81,6 @@ class BottleneckV1(HybridBlock):
         x = F.Activation(x + residual, act_type="relu")
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class BasicBlockV2(HybridBlock):
@@ -116,9 +109,6 @@ class BasicBlockV2(HybridBlock):
         x = self.conv2._forward_impl(x)
         return x + residual
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class BottleneckV2(HybridBlock):
@@ -154,9 +144,6 @@ class BottleneckV2(HybridBlock):
         x = self.conv3._forward_impl(x)
         return x + residual
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class ResNetV1(HybridBlock):
@@ -199,9 +186,6 @@ class ResNetV1(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class ResNetV2(HybridBlock):
@@ -250,9 +234,6 @@ class ResNetV2(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 resnet_spec = {18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),

@@ -34,9 +34,6 @@ class _FireExpand(HybridBlock):
         return F.Concat(self.p1._forward_impl(x), self.p3._forward_impl(x),
                         dim=1)
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 class SqueezeNet(HybridBlock):
@@ -93,9 +90,6 @@ class SqueezeNet(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 def get_squeezenet(version, pretrained=False, ctx=cpu(), root=None, **kwargs):

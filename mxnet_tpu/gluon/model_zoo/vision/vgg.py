@@ -49,9 +49,6 @@ class VGG(HybridBlock):
         x = self.output._forward_impl(x)
         return x
 
-    def _forward_impl(self, x):
-        from .... import ndarray as F
-        return self.hybrid_forward(F, x)
 
 
 vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),

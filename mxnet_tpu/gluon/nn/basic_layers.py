@@ -86,8 +86,16 @@ class HybridSequential(HybridBlock):
         raise RuntimeError("HybridSequential dispatches via _forward_impl")
 
     def _forward_impl(self, x):
+        from ...symbol import Symbol
+        if isinstance(x, Symbol):
+            return self._symbolic_forward(x)
         for block in self._children.values():
             x = block._forward_impl(x) if isinstance(block, HybridBlock) else block(x)
+        return x
+
+    def _symbolic_forward(self, x):
+        for block in self._children.values():
+            x = block._symbolic_forward(x)
         return x
 
     def __repr__(self):

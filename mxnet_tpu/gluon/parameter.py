@@ -261,6 +261,9 @@ class ParameterDict:
     def __iter__(self):
         return iter(self._params)
 
+    def __len__(self):
+        return len(self._params)
+
     def items(self):
         return self._params.items()
 

@@ -145,6 +145,7 @@ class ImageRecordIterImpl(DataIter):
         shard = len(keys) // self.num_parts
         lo = self.part_index * shard
         hi = lo + shard if self.part_index < self.num_parts - 1 else len(keys)
+        self._all_keys = keys
         self._keys = keys[lo:hi]
 
     def _decode_one(self, rec_handle, key):
@@ -279,11 +280,14 @@ class ImageDetRecordIter(ImageRecordIterImpl):
             self.label_pad_width = self._scan_max_label_width()
 
     def _scan_max_label_width(self):
+        # scan ALL records, not this worker's shard: every distributed
+        # worker must agree on the label batch shape (the reference
+        # estimates pad width globally, iter_image_det_recordio.cc:289)
         rec = (rio.MXIndexedRecordIO(self.idx_path, self.path_imgrec, "r")
                if self._use_idx else rio.MXRecordIO(self.path_imgrec, "r"))
         width = 0
         try:
-            for key in self._keys:
+            for key in self._all_keys:
                 if self._use_idx:
                     s = rec.read_idx(key)
                 else:

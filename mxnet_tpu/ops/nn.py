@@ -129,6 +129,12 @@ def _pooling(params, data):
         kernel = _tup(params["kernel"], nd, 1)
         stride = _tup(params.get("stride"), nd, 1)
         pad = _tup(params.get("pad"), nd, 0)
+        from ..base import MXNetError
+        for i, (k, p) in enumerate(zip(kernel, pad)):
+            if k > data.shape[2 + i] + 2 * p:
+                raise MXNetError(
+                    "Pooling kernel %s exceeds padded input %s"
+                    % (kernel, data.shape[2:]))
     window = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
     padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)

