@@ -158,6 +158,13 @@ class KVStore:
     def send_command_to_servers(self, head, body):
         """PS command channel; server-free on TPU — no-op for parity."""
 
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Failure detection (reference kvstore.h:338 backed by ps-lite
+        heartbeats). Collectives have no heartbeat protocol: a dead peer
+        surfaces as a collective error/timeout instead, so a queryable
+        live cluster reports 0 dead nodes."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
