@@ -23,3 +23,4 @@ def ones_like(a, **kw):
     from ..ops.invoke import invoke
     return invoke("ones_like", [a], kw)
 from . import contrib  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401

@@ -1,0 +1,21 @@
+"""`mx.nd.linalg` namespace (reference python/mxnet/ndarray/linalg.py):
+every registered `_linalg_*` op exposed by its short name (gemm, gemm2,
+potrf, potri, trsm, trmm, sumlogdiag, syrk, gelqf, syevd, inverse, det).
+"""
+from ..ops.registry import _OPS
+from .register import _make_fn
+
+
+def _populate_linalg(namespace, make_fn):
+    names = []
+    for name, op in list(_OPS.items()):
+        if not op.visible or not name.startswith("_linalg_"):
+            continue
+        short = name[len("_linalg_"):]
+        if short not in namespace:
+            namespace[short] = make_fn(name)
+            names.append(short)
+    return names
+
+
+__all__ = _populate_linalg(globals(), _make_fn)

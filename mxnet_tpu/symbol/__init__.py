@@ -17,3 +17,4 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
     return globals()["_arange"](start=start, stop=stop, step=step,
                                 repeat=repeat, dtype=dtype or "float32", **kwargs)
 from . import contrib  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401

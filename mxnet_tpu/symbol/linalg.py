@@ -1,0 +1,6 @@
+"""`mx.sym.linalg` namespace (reference python/mxnet/symbol/linalg.py)."""
+from ..ops.registry import _OPS
+from .register import _make_fn
+from ..ndarray.linalg import _populate_linalg
+
+__all__ = _populate_linalg(globals(), _make_fn)

