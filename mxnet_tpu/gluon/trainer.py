@@ -39,6 +39,7 @@ class Trainer:
         self._kvstore_type = kvstore
         self._kvstore = None
         self._update_on_kvstore = update_on_kvstore
+        self._fused = None  # lazily resolved FusedApplier (or False)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -127,6 +128,21 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        if not (self._update_on_kvstore and self._kvstore is not None):
+            if self._fused is None:
+                self._fused = opt.FusedApplier.resolve(self._updaters[0])
+            if self._fused:
+                # one compiled dispatch updating every parameter (see
+                # FusedApplier) instead of one dispatch per parameter
+                idxs, ws, gs = [], [], []
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        idxs.append(i)
+                        ws.append(param.data())
+                        gs.append(param.grad())
+                if idxs:
+                    self._fused(idxs, ws, gs)
+                return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue

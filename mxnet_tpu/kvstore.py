@@ -52,6 +52,7 @@ class KVStore:
         self._key_type = None
         self._compression = {}
         self._gc = None
+        self._fused = None  # lazily resolved FusedApplier (or False)
 
     # -- identity --------------------------------------------------------
     @property
@@ -84,6 +85,7 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = _normalize(key, value)
+        batch = []
         for k, vs in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -97,9 +99,28 @@ class KVStore:
                 merged = self._allreduce(merged)
             stored = self._store[k]
             if self._updater is not None:
-                self._updater(k, merged.as_in_context(stored.context), stored)
+                batch.append((k, merged.as_in_context(stored.context),
+                              stored))
             else:
                 stored[:] = merged.as_in_context(stored.context)
+        if batch:
+            self._apply_updates(batch)
+
+    def _apply_updates(self, batch):
+        """Run the updater over pushed keys; a list push with the standard
+        Updater applies every key in ONE compiled dispatch (FusedApplier),
+        the analog of the reference's engine-bulked server updates."""
+        if len(batch) > 1 and self._fused is not False:
+            if self._fused is None:
+                self._fused = opt.FusedApplier.resolve(self._updater)
+            if self._fused:
+                idxs = [k for k, _, _ in batch]
+                grads = [g for _, g, _ in batch]
+                ws = [w for _, _, w in batch]
+                self._fused(idxs, ws, grads)
+                return
+        for k, merged, stored in batch:
+            self._updater(k, merged, stored)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _normalize(key, out)
@@ -131,9 +152,11 @@ class KVStore:
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
+        self._fused = None
 
     def _set_updater(self, updater):
         self._updater = updater
+        self._fused = None
 
     def set_gradient_compression(self, compression_params):
         """Enable 2-bit gradient compression with error feedback
@@ -176,6 +199,8 @@ class KVStore:
             raise MXNetError("Cannot load states for distributed training")
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
+        # set_states may replace the updater's optimizer object
+        self._fused = None
 
 
 def _normalize(key, value):

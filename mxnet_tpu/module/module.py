@@ -52,6 +52,8 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 
 class Module(BaseModule):
+    _fused = None  # fused optimizer applier, resolved at first update
+
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
                  fixed_param_names=None, state_names=None, group2ctxs=None,
@@ -295,6 +297,7 @@ class Module(BaseModule):
             return
         if self._params_dirty:
             self._sync_params_from_devices()
+        self._fused = None  # re-resolve the fused applier per optimizer
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), {n: self._exec.arg_dict[n]
                                           for n in self._param_names})
@@ -347,6 +350,7 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._fused = None  # re-resolve against the borrowed updater
         self.optimizer_initialized = True
 
     # -- compute ---------------------------------------------------------
@@ -389,32 +393,34 @@ class Module(BaseModule):
         """Reference module.py:631 + model.py _update_params(_on_kvstore)."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        live = [(i, name, self._exec.grad_dict.get(name))
+                for i, name in enumerate(self._param_names)
+                if self._grad_req.get(name) != "null"
+                and self._exec.grad_dict.get(name) is not None]
         if self._update_on_kvstore:
-            for i, name in enumerate(self._param_names):
-                if self._grad_req.get(name) == "null":
-                    continue
-                grad = self._exec.grad_dict.get(name)
-                if grad is None:
-                    continue
-                self._kvstore.push(i, grad)
-                self._kvstore.pull(i, self._exec.arg_dict[name])
+            # list push/pull: the kvstore applies every key's update in
+            # one dispatch when the optimizer is fusable
+            self._kvstore.push([i for i, _, _ in live],
+                               [g for _, _, g in live])
+            self._kvstore.pull([i for i, _, _ in live],
+                               [self._exec.arg_dict[name]
+                                for _, name, _ in live])
         else:
             if self._kvstore:
-                for i, name in enumerate(self._param_names):
-                    if self._grad_req.get(name) == "null":
-                        continue
-                    grad = self._exec.grad_dict.get(name)
-                    if grad is None:
-                        continue
-                    self._kvstore.push(i, grad)
-                    self._kvstore.pull(i, grad)
-            for i, name in enumerate(self._param_names):
-                if self._grad_req.get(name) == "null":
-                    continue
-                grad = self._exec.grad_dict.get(name)
-                if grad is None:
-                    continue
-                self._updater(i, grad, self._exec.arg_dict[name])
+                self._kvstore.push([i for i, _, _ in live],
+                                   [g for _, _, g in live])
+                self._kvstore.pull([i for i, _, _ in live],
+                                   [g for _, _, g in live])
+            if self._fused is None:
+                from .. import optimizer as opt
+                self._fused = opt.FusedApplier.resolve(self._updater)
+            if self._fused:
+                self._fused([i for i, _, _ in live],
+                            [self._exec.arg_dict[name] for _, name, _ in live],
+                            [g for _, _, g in live])
+            else:
+                for i, name, grad in live:
+                    self._updater(i, grad, self._exec.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -19,6 +19,7 @@ from .ndarray import NDArray, zeros
 from .ops.invoke import invoke
 
 __all__ = ["Optimizer", "SGD", "NAG", "Signum", "Adam", "AdaGrad", "AdaDelta",
+           "FusedApplier",
            "RMSProp", "Ftrl", "FTML", "DCASGD", "LBSGD", "SGLD", "Test",
            "Updater", "get_updater", "create", "register"]
 
@@ -530,3 +531,134 @@ def create(name, **kwargs):
     if isinstance(name, Optimizer):
         return name
     return Optimizer.create_optimizer(name, **kwargs)
+
+
+class FusedApplier:
+    """Apply an optimizer to MANY parameters in ONE compiled dispatch.
+
+    Eager per-parameter updates cost one host->device dispatch each — for
+    a ResNet-50 that is ~160 dispatches per step, which dominates step
+    time whenever dispatch latency is nontrivial (always true for a
+    remote/tunneled chip; the reference amortizes the same cost by
+    running updates inside engine bulk segments, graph_executor.cc:1377).
+
+    This wrapper traces the SAME registered update ops
+    (`ops/optimizer_ops.py`) over every parameter inside a single jitted
+    function. Per-step scalars (lr after scheduler/bias-correction, wd,
+    rescale_grad) enter as traced inputs so nothing retraces as they
+    change. Supported: SGD (fp32, +momentum), Adam; callers fall back to
+    per-parameter updates otherwise.
+
+    States are shared with the wrapped `Updater`, so optimizer-state
+    save/load round-trips unchanged.
+    """
+
+    def __init__(self, updater):
+        from .ops.registry import get_op
+        self.updater = updater
+        self.optimizer = updater.optimizer
+        self._get_op = get_op
+        self._jit_cache = {}
+
+    @staticmethod
+    def supports(optimizer):
+        return type(optimizer) in (SGD, Adam) \
+            and not getattr(optimizer, "multi_precision", False)
+
+    @classmethod
+    def resolve(cls, updater):
+        """FusedApplier for the updater's optimizer, or False when the
+        per-parameter path must be used. The single resolution point for
+        every caller caching a `_fused` attribute."""
+        if isinstance(updater, Updater) and cls.supports(updater.optimizer):
+            return cls(updater)
+        return False
+
+    def _op_name(self):
+        if isinstance(self.optimizer, Adam):
+            return "adam_update"
+        return "sgd_mom_update" if self.optimizer.momentum != 0.0 \
+            else "sgd_update"
+
+    def __call__(self, indices, weights, grads):
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+
+        opt = self.optimizer
+        upd = self.updater
+        # host-side bookkeeping identical to Updater.__call__
+        for i, w in zip(indices, weights):
+            if i not in upd.states:
+                upd.states[i] = opt.create_state_multi_precision(i, w)
+                upd.states_synced[i] = True
+            opt._update_count(i)
+
+        lrs, wds = [], []
+        for i in indices:
+            lr = opt._get_lr(i)
+            if isinstance(opt, Adam):
+                t = opt._index_update_count[i]
+                lr = lr * math.sqrt(1.0 - opt.beta2 ** t) \
+                    / (1.0 - opt.beta1 ** t)
+            lrs.append(lr)
+            wds.append(opt._get_wd(i))
+        lrs = jnp.asarray(_np.asarray(lrs, _np.float32))
+        wds = jnp.asarray(_np.asarray(wds, _np.float32))
+        rescale = jnp.float32(opt.rescale_grad)
+
+        op_name = self._op_name()
+        op = self._get_op(op_name)
+        static = {"clip_gradient": opt.clip_gradient or -1.0}
+        if op_name == "sgd_mom_update":
+            static["momentum"] = opt.momentum
+        if op_name == "adam_update":
+            static.update(beta1=opt.beta1, beta2=opt.beta2,
+                          epsilon=opt.epsilon)
+
+        w_vals = [w._data for w in weights]
+        g_vals = [g._data for g in grads]
+        state_vals = []
+        for i in indices:
+            s = upd.states[i]
+            if s is None:
+                state_vals.append(())
+            elif isinstance(s, tuple):
+                state_vals.append(tuple(x._data for x in s))
+            else:
+                state_vals.append((s._data,))
+
+        key = (op_name, tuple(static.items()),
+               tuple((v.shape, str(v.dtype)) for v in w_vals))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fcompute = op.fcompute
+
+            def apply_all(lrs, wds, rescale, ws, gs, states):
+                new_ws, new_states = [], []
+                for k in range(len(ws)):
+                    params = dict(static)
+                    params["lr"] = lrs[k]
+                    params["wd"] = wds[k]
+                    params["rescale_grad"] = rescale
+                    outs = fcompute(params, ws[k], gs[k], *states[k])
+                    new_ws.append(outs[0])
+                    new_states.append(tuple(outs[1:]))
+                return new_ws, new_states
+
+            fn = jax.jit(apply_all)
+            self._jit_cache[key] = fn
+
+        new_ws, new_states = fn(lrs, wds, rescale, w_vals, g_vals,
+                                state_vals)
+        for w, nv in zip(weights, new_ws):
+            w._data = nv
+        for i, ns in zip(indices, new_states):
+            s = upd.states[i]
+            if s is None:
+                continue
+            if isinstance(s, tuple):
+                for old, new in zip(s, ns):
+                    old._data = new
+            else:
+                s._data = ns[0]

@@ -1,0 +1,87 @@
+"""FusedApplier: one-dispatch optimizer application must be numerically
+identical to the per-parameter update path."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def _make_pair(opt_name, opt_params):
+    nets = []
+    for _ in range(2):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu", in_units=4), nn.Dense(3, in_units=8))
+        net.initialize(mx.init.Xavier())
+        nets.append(net)
+    # identical initial weights
+    src = nets[0].collect_params()
+    dst = nets[1].collect_params()
+    for (kn, ps), (kd, pd) in zip(src.items(), dst.items()):
+        pd.set_data(ps.data())
+    trainers = [gluon.Trainer(n.collect_params(), opt_name, dict(opt_params))
+                for n in nets]
+    return nets, trainers
+
+
+def _run(net, trainer, steps, force_per_param=False):
+    if force_per_param:
+        trainer._fused = False
+    rng = np.random.RandomState(0)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for s in range(steps):
+        x = mx.nd.array(rng.randn(6, 4).astype("f"))
+        y = mx.nd.array(rng.randint(0, 3, 6).astype("f"))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(6)
+    return {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+
+
+@pytest.mark.parametrize("opt_name,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("sgd", {"learning_rate": 0.05}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-4}),
+])
+def test_fused_matches_per_param(opt_name, opt_params):
+    nets, trainers = _make_pair(opt_name, opt_params)
+    fused = _run(nets[0], trainers[0], steps=5)
+    assert trainers[0]._fused, "fused path should have engaged"
+    ref = _run(nets[1], trainers[1], steps=5, force_per_param=True)
+    for (kf, vf), (kr, vr) in zip(fused.items(), ref.items()):
+        np.testing.assert_allclose(vf, vr, rtol=1e-6, atol=1e-7,
+                                   err_msg="%s vs %s" % (kf, kr))
+
+
+def test_fused_with_lr_scheduler_no_retrace_explosion():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    nets, _ = _make_pair("sgd", {"learning_rate": 0.1})
+    net = nets[0]
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "lr_scheduler": sched,
+                             "momentum": 0.9})
+    _run(net, trainer, steps=6)
+    assert trainer._fused
+    # lr changed across steps but the jit cache holds ONE entry
+    assert len(trainer._fused._jit_cache) == 1
+    assert trainer.learning_rate < 0.1
+
+
+def test_fused_states_serializable(tmp_path):
+    nets, trainers = _make_pair("adam", {"learning_rate": 0.01})
+    _run(nets[0], trainers[0], steps=3)
+    fname = str(tmp_path / "states")
+    trainers[0].save_states(fname)
+    trainers[0].load_states(fname)
+    _run(nets[0], trainers[0], steps=1)
+
+
+def test_unsupported_optimizer_falls_back():
+    nets, _ = _make_pair("sgd", {"learning_rate": 0.1})
+    net = nets[0]
+    trainer = gluon.Trainer(net.collect_params(), "rmsprop",
+                            {"learning_rate": 0.01})
+    _run(net, trainer, steps=2)
+    assert trainer._fused is False
