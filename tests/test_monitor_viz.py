@@ -1,0 +1,67 @@
+"""Monitor, visualization and test_utils harness coverage (reference
+tests: test_monitor.py, print_summary usage, check_consistency from
+test_utils.py:1207)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="sm")
+
+
+def test_monitor_collects_stats():
+    net = _mlp()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc.*")
+    ex = net.simple_bind(mx.cpu(), data=(4, 10))
+    mon.install(ex)
+    for arr in ex.arg_arrays:
+        arr[:] = np.random.RandomState(0).rand(*arr.shape).astype("f")
+    mon.tic()
+    ex.forward()
+    stats = mon.toc()
+    assert stats, "monitor should capture fc tensors"
+    names = [n for _, n, _ in stats]
+    assert any("fc1" in n for n in names)
+    assert not any("relu" in n for n in names)  # pattern filtered
+
+
+def test_print_summary(capsys):
+    net = _mlp()
+    mx.visualization.print_summary(net, shape={"data": (1, 10)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+
+
+def test_check_symbolic_forward_backward():
+    a = mx.sym.Variable("a")
+    out = 2 * a
+    x = np.random.RandomState(1).rand(3, 4).astype("f")
+    tu.check_symbolic_forward(out, [x], [2 * x])
+    tu.check_symbolic_backward(out, [x], [np.ones_like(x)],
+                               [2 * np.ones_like(x)])
+
+
+def test_check_numeric_gradient():
+    a = mx.sym.Variable("a")
+    out = mx.sym.sum(a * a)
+    x = np.random.RandomState(2).rand(4).astype("f")
+    tu.check_numeric_gradient(out, [x])
+
+
+def test_check_consistency_across_dtypes():
+    """The reference's kernel-parity harness: same symbol under several
+    ctx/dtype combos, outputs cross-checked (test_utils.py:1207)."""
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    ctx_list = [
+        {"ctx": mx.cpu(), "data": (2, 6), "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(), "data": (2, 6), "type_dict": {"data": np.float64}},
+    ]
+    tu.check_consistency(sym, ctx_list)
