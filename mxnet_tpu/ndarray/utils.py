@@ -1,47 +1,162 @@
-"""NDArray save/load (reference `python/mxnet/ndarray/utils.py:149,222` and
-the C++ serializer `src/ndarray/ndarray.cc:1596,1709,1794`).
+"""NDArray save/load (reference `python/mxnet/ndarray/utils.py:149,222`,
+C++ serializer `src/ndarray/ndarray.cc:1596,1709,1794`).
 
-Format: a `.npz`-based container (portable, fast) with the reference's
-dict/list semantics: saving a list stores keys ``arr_0..arr_n``; loading
-returns a list or a dict depending on how it was saved.
+Writes the reference's EXACT binary container so `.params` files
+interchange with stock MXNet 1.2.1:
+
+    uint64 0x112 (kMXAPINDArrayListMagic), uint64 reserved
+    uint64 count, count x NDArray records
+    uint64 count, count x (uint64 len + bytes) names
+
+NDArray record (NDARRAY_V2_MAGIC, dense):
+    uint32 0xF993fac9; int32 stype (0 = default);
+    shape = uint32 ndim + int64[ndim]; int32 dev_type, int32 dev_id;
+    int32 type_flag (mshadow); raw row-major data.
+
+Loading also accepts V1 (0xF993fac8) records and this project's earlier
+``.npz`` container. bfloat16 uses type_flag 12 — an extension the
+reference cannot read (it has no bf16 type).
 """
 from __future__ import annotations
 
+import struct
+
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, _DTYPE_NP_TO_MX, _DTYPE_MX_TO_NP
 from .ndarray import NDArray, array
 
 __all__ = ["save", "load"]
 
 _LIST_KEY = "__mx_tpu_list__"
+_LIST_MAGIC = 0x112
+_ND_V2_MAGIC = 0xF993FAC9
+_ND_V1_MAGIC = 0xF993FAC8
+
+
+def _write_nd(f, arr):
+    np_arr = np.ascontiguousarray(arr.asnumpy())
+    if arr.ndim == 0:
+        # ndim 0 means "uninitialized" in the reference format; a 0-dim
+        # scalar is not representable (MXNet 1.2.1 has none)
+        raise MXNetError("cannot serialize a 0-dim NDArray to the .params "
+                         "format; reshape to (1,) first")
+    if np_arr.dtype == np.bool_:
+        raise MXNetError("cannot serialize bool NDArrays to the .params "
+                         "format (no mshadow bool type in the reference); "
+                         "cast to uint8 first")
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", 0))                 # kDefaultStorage
+    f.write(struct.pack("<I", np_arr.ndim))
+    f.write(struct.pack("<%dq" % np_arr.ndim, *np_arr.shape))
+    f.write(struct.pack("<ii", 1, 0))             # Context: cpu(0)
+    flag = _DTYPE_NP_TO_MX.get(np.dtype(np_arr.dtype))
+    if flag is None or flag < 0:
+        raise MXNetError("cannot serialize dtype %s" % np_arr.dtype)
+    f.write(struct.pack("<i", flag))
+    f.write(np_arr.tobytes())
+
+
+def _read_exact(f, n):
+    buf = f.read(n)
+    if len(buf) != n:
+        raise MXNetError("Invalid NDArray file format (truncated)")
+    return buf
+
+
+def _read_nd(f):
+    magic = struct.unpack("<I", _read_exact(f, 4))[0]
+    if magic == _ND_V2_MAGIC:
+        stype = struct.unpack("<i", _read_exact(f, 4))[0]
+        if stype != 0:
+            raise MXNetError(
+                "sparse storage type %d in .params files is not supported; "
+                "convert to dense before saving" % stype)
+        ndim = struct.unpack("<I", _read_exact(f, 4))[0]
+    elif magic == _ND_V1_MAGIC:
+        ndim = struct.unpack("<I", _read_exact(f, 4))[0]
+    else:
+        # legacy pre-V1 record: the magic IS the ndim, dims are uint32
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError("Invalid NDArray file format")
+        shape = struct.unpack("<%dI" % ndim, _read_exact(f, 4 * ndim)) \
+            if ndim else ()
+        return _read_nd_body(f, shape)
+    if ndim == 0:
+        # reference is_none() record (Save writes only magic/stype/shape)
+        raise MXNetError("file contains an uninitialized NDArray record, "
+                         "which this framework cannot represent")
+    shape = struct.unpack("<%dq" % ndim, _read_exact(f, 8 * ndim))
+    return _read_nd_body(f, shape)
+
+
+def _read_nd_body(f, shape):
+    _read_exact(f, 8)  # context dev_type + dev_id
+    flag = struct.unpack("<i", _read_exact(f, 4))[0]
+    dtype = _DTYPE_MX_TO_NP.get(flag)
+    if dtype is None:
+        raise MXNetError("unknown dtype flag %d in NDArray file" % flag)
+    n = 1
+    for s in shape:
+        n *= s
+    data = np.frombuffer(_read_exact(f, n * dtype.itemsize),
+                         dtype=dtype).reshape(shape)
+    return data
 
 
 def save(fname, data):
+    """Save NDArrays in the reference binary format (list or dict)."""
     if isinstance(data, NDArray):
         data = [data]
-    payload = {}
     if isinstance(data, dict):
-        for k, v in data.items():
-            if not isinstance(v, NDArray):
-                raise MXNetError("save only supports NDArray values")
-            payload[k] = v.asnumpy()
+        names = list(data.keys())
+        arrs = [data[k] for k in names]
     elif isinstance(data, (list, tuple)):
-        payload[_LIST_KEY] = np.array(len(data))
-        for i, v in enumerate(data):
-            payload["arr_%d" % i] = v.asnumpy()
+        names = []
+        arrs = list(data)
     else:
         raise MXNetError("data needs to either be a NDArray, dict of str, "
                          "NDArray pairs or a list of NDarrays.")
+    for v in arrs:
+        if not isinstance(v, NDArray):
+            raise MXNetError("save only supports NDArray values")
     with open(fname, "wb") as f:
-        np.savez(f, **payload)
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrs)))
+        for v in arrs:
+            _write_nd(f, v)
+        f.write(struct.pack("<Q", len(names)))
+        for k in names:
+            kb = k.encode("utf-8")
+            f.write(struct.pack("<Q", len(kb)))
+            f.write(kb)
 
 
 def load(fname, ctx=None):
+    """Load NDArrays saved by `save` or by the reference framework."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        if len(head) == 8 and struct.unpack("<Q", head)[0] == _LIST_MAGIC:
+            _read_exact(f, 8)  # reserved
+            count = struct.unpack("<Q", _read_exact(f, 8))[0]
+            arrs = [_read_nd(f) for _ in range(count)]
+            n_names = struct.unpack("<Q", _read_exact(f, 8))[0]
+            names = []
+            for _ in range(n_names):
+                ln = struct.unpack("<Q", _read_exact(f, 8))[0]
+                names.append(_read_exact(f, ln).decode("utf-8"))
+            if names and len(names) != len(arrs):
+                raise MXNetError("Invalid NDArray file format")
+            nds = [array(a, ctx=ctx, dtype=a.dtype) for a in arrs]
+            if names:
+                return dict(zip(names, nds))
+            return nds
+    # fall back to the earlier .npz container
     with np.load(fname, allow_pickle=False) as npz:
         keys = list(npz.keys())
         if _LIST_KEY in keys:
             n = int(npz[_LIST_KEY])
-            return [array(npz["arr_%d" % i], ctx=ctx, dtype=npz["arr_%d" % i].dtype)
-                    for i in range(n)]
+            return [array(npz["arr_%d" % i], ctx=ctx,
+                          dtype=npz["arr_%d" % i].dtype) for i in range(n)]
         return {k: array(npz[k], ctx=ctx, dtype=npz[k].dtype) for k in keys}

@@ -1,0 +1,106 @@
+"""Reference .params binary-format compatibility
+(src/ndarray/ndarray.cc:1596,1792): byte-level container layout,
+roundtrips, npz back-compat."""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_dict_roundtrip(tmp_path):
+    path = str(tmp_path / "m.params")
+    rng = np.random.RandomState(0)
+    data = {"arg:fc_weight": mx.nd.array(rng.randn(4, 3).astype("f")),
+            "arg:fc_bias": mx.nd.array(rng.randn(4).astype("f")),
+            "aux:bn_mean": mx.nd.array(rng.randn(4).astype("float64"))}
+    mx.nd.save(path, data)
+    loaded = mx.nd.load(path)
+    assert sorted(loaded) == sorted(data)
+    for k in data:
+        np.testing.assert_allclose(loaded[k].asnumpy(), data[k].asnumpy())
+        assert loaded[k].dtype == data[k].dtype
+
+
+def test_list_roundtrip(tmp_path):
+    path = str(tmp_path / "l.params")
+    arrs = [mx.nd.ones((2, 2)), mx.nd.zeros((3,))]
+    mx.nd.save(path, arrs)
+    loaded = mx.nd.load(path)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    np.testing.assert_allclose(loaded[0].asnumpy(), 1.0)
+
+
+def test_exact_container_bytes(tmp_path):
+    """Byte-level check against the reference writer's layout."""
+    path = str(tmp_path / "b.params")
+    arr = mx.nd.array(np.arange(6, dtype="f").reshape(2, 3))
+    mx.nd.save(path, {"w": arr})
+    raw = open(path, "rb").read()
+    # container header
+    assert struct.unpack("<QQ", raw[:16]) == (0x112, 0)
+    assert struct.unpack("<Q", raw[16:24]) == (1,)   # one array
+    # ndarray record: V2 magic, stype 0, ndim 2, dims 2,3
+    off = 24
+    assert struct.unpack("<I", raw[off:off + 4])[0] == 0xF993FAC9
+    assert struct.unpack("<i", raw[off + 4:off + 8])[0] == 0
+    assert struct.unpack("<I", raw[off + 8:off + 12])[0] == 2
+    assert struct.unpack("<qq", raw[off + 12:off + 28]) == (2, 3)
+    # context cpu(0), dtype flag 0 (float32)
+    assert struct.unpack("<iii", raw[off + 28:off + 40]) == (1, 0, 0)
+    payload = np.frombuffer(raw[off + 40:off + 40 + 24], "f")
+    np.testing.assert_allclose(payload, np.arange(6, dtype="f"))
+    # names
+    noff = off + 40 + 24
+    assert struct.unpack("<Q", raw[noff:noff + 8]) == (1,)
+    ln = struct.unpack("<Q", raw[noff + 8:noff + 16])[0]
+    assert raw[noff + 16:noff + 16 + ln] == b"w"
+
+
+def test_reads_reference_written_v1(tmp_path):
+    """Hand-build a V1-record file as old MXNet would write it."""
+    path = str(tmp_path / "v1.params")
+    arr = np.arange(4, dtype="f")
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQ", 0x112, 0))
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<I", 0xF993FAC8))        # V1: no stype
+        f.write(struct.pack("<I", 1))                 # ndim
+        f.write(struct.pack("<q", 4))
+        f.write(struct.pack("<ii", 1, 0))             # cpu(0)
+        f.write(struct.pack("<i", 0))                 # float32
+        f.write(arr.tobytes())
+        f.write(struct.pack("<Q", 0))                 # no names
+    loaded = mx.nd.load(path)
+    np.testing.assert_allclose(loaded[0].asnumpy(), arr)
+
+
+def test_npz_backcompat(tmp_path):
+    """Files written by the earlier npz container still load."""
+    path = str(tmp_path / "old.params")
+    with open(path, "wb") as f:
+        np.savez(f, w=np.ones((2, 2), "f"))
+    loaded = mx.nd.load(path)
+    np.testing.assert_allclose(loaded["w"].asnumpy(), 1.0)
+
+
+def test_checkpoint_uses_reference_format(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3)
+    mod = mx.mod.Module(net, label_names=None)
+    mod.bind([mx.io.DataDesc("data", (2, 5))], None)
+    mod.init_params()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+    raw = open(prefix + "-0001.params", "rb").read()
+    assert struct.unpack("<Q", raw[:8])[0] == 0x112
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 1)
+    assert "fullyconnected0_weight" in arg
+
+
+def test_unrepresentable_values_rejected(tmp_path):
+    path = str(tmp_path / "bad.params")
+    with pytest.raises(mx.MXNetError, match="0-dim"):
+        mx.nd.save(path, [mx.nd.array(np.float32(1.0).reshape(()))])
+    with pytest.raises(mx.MXNetError, match="bool"):
+        mx.nd.save(path, [mx.nd.array(np.ones((2,), bool))])
