@@ -18,6 +18,7 @@ from .codec import imdecode_np, imencode
 __all__ = ["imdecode", "imread", "imresize", "fixed_crop", "random_crop",
            "center_crop", "color_normalize", "resize_short", "scale_down",
            "ImageIter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "HueJitterAug", "RandomGrayAug",
            "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
            "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "LightingAug", "ColorJitterAug",
@@ -234,6 +235,51 @@ class LightingAug(Augmenter):
         return src + array(rgb.astype(np.float32))
 
 
+class HueJitterAug(Augmenter):
+    """Random hue rotation in YIQ space (reference image.py HueJitterAug:
+    tyiq / ityiq matrices)."""
+
+    _TYIQ = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]])
+    _ITYIQ = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]])
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t = self._ITYIQ.dot(bt).dot(self._TYIQ).T.astype(np.float32)
+        arr = src.asnumpy() if isinstance(src, NDArray) else src
+        out = arr.astype(np.float32).dot(t)
+        return array(out.astype(np.float32))
+
+
+class RandomGrayAug(Augmenter):
+    """Convert to 3-channel grayscale with probability p (reference
+    image.py RandomGrayAug)."""
+
+    _MAT = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else src
+            src = array(arr.astype(np.float32).dot(self._MAT))
+        return src
+
+
 class ColorJitterAug(Augmenter):
     def __init__(self, brightness, contrast, saturation):
         super().__init__(brightness=brightness, contrast=contrast,
@@ -256,7 +302,7 @@ class ColorJitterAug(Augmenter):
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
                     inter_method=2):
     """Reference image.py CreateAugmenter."""
     auglist = []
@@ -272,12 +318,16 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
     if pca_noise > 0:
         eigval = np.array([55.46, 4.794, 1.148])
         eigvec = np.array([[-0.5675, 0.7192, 0.4009],
                            [-0.5808, -0.0045, -0.8140],
                            [-0.5836, -0.6948, 0.4203]])
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
@@ -401,3 +451,13 @@ class ImageIter:
                 break
         lab = batch_label[:, 0] if self.label_width == 1 else batch_label
         return DataBatch(data=[array(batch_data)], label=[array(lab)], pad=pad)
+
+
+from . import detection  # noqa: E402,F401
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,  # noqa: E402,F401
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter, ImageDetIter)
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "CreateDetAugmenter", "ImageDetIter"]

@@ -1,0 +1,343 @@
+"""Detection-aware imperative image iterator + augmenters
+(reference python/mxnet/image/detection.py: DetAugmenter family,
+CreateDetAugmenter, ImageDetIter).
+
+Labels follow the detection record layout
+(`image_det_aug_default.cc:254`): flat
+``[header_width(>=2), object_width(>=5), headers..., objects...]``,
+each object ``[id, x1, y1, x2, y2, ...]`` with normalized coordinates.
+Augmenters transform (image, boxes) together.
+"""
+from __future__ import annotations
+
+import json
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+from . import (Augmenter, CreateAugmenter, ResizeAug, ForceResizeAug,
+               imresize, ImageIter)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter(object):
+    """Detection augmenter base (reference detection.py:44)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter for detection (reference :66):
+    applies it to the image, leaves boxes untouched (only safe for
+    color/cast augmenters and exact resizes recorded in the label)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise MXNetError("DetBorrowAug requires an Augmenter instance")
+        super(DetBorrowAug, self).__init__(
+            augmenter=augmenter.dumps() if hasattr(augmenter, "dumps")
+            else str(augmenter))
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one of the given det augmenters (reference :90)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super(DetRandomSelectAug, self).__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + boxes with probability p (reference :117)."""
+
+    def __init__(self, p):
+        super(DetHorizontalFlipAug, self).__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            if not isinstance(src, NDArray):
+                src = array(np.ascontiguousarray(src))
+            src = src.flip(axis=1)  # on-device, like HorizontalFlipAug
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by object coverage (reference :139):
+    sample crops until one keeps >= min_object_covered IoU-coverage of
+    at least one object; boxes are clipped/renormalized, objects whose
+    center leaves the crop are dropped."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        super(DetRandomCropAug, self).__init__(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range, area_range=area_range,
+            min_eject_coverage=min_eject_coverage,
+            max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _coverage(self, boxes, crop):
+        x1 = np.maximum(boxes[:, 1], crop[0])
+        y1 = np.maximum(boxes[:, 2], crop[1])
+        x2 = np.minimum(boxes[:, 3], crop[2])
+        y2 = np.minimum(boxes[:, 4], crop[3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        areas = (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 4] - boxes[:, 2])
+        return inter / np.maximum(areas, 1e-12)
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if isinstance(src, NDArray) else src
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, np.sqrt(area * ratio))
+            ch = min(1.0, np.sqrt(area / ratio))
+            cx = pyrandom.uniform(0, 1.0 - cw)
+            cy = pyrandom.uniform(0, 1.0 - ch)
+            crop = (cx, cy, cx + cw, cy + ch)
+            cov = self._coverage(label, crop)
+            if not (cov >= self.min_object_covered).any():
+                continue
+            centers_x = (label[:, 1] + label[:, 3]) / 2
+            centers_y = (label[:, 2] + label[:, 4]) / 2
+            keep = ((centers_x > crop[0]) & (centers_x < crop[2])
+                    & (centers_y > crop[1]) & (centers_y < crop[3])
+                    & (cov >= self.min_eject_coverage))
+            if not keep.any():
+                continue
+            new = label[keep].copy()
+            new[:, 1] = (np.clip(new[:, 1], crop[0], crop[2]) - crop[0]) / cw
+            new[:, 3] = (np.clip(new[:, 3], crop[0], crop[2]) - crop[0]) / cw
+            new[:, 2] = (np.clip(new[:, 2], crop[1], crop[3]) - crop[1]) / ch
+            new[:, 4] = (np.clip(new[:, 4], crop[1], crop[3]) - crop[1]) / ch
+            x0, y0 = int(crop[0] * w), int(crop[1] * h)
+            x1, y1 = max(x0 + 1, int(crop[2] * w)), max(y0 + 1,
+                                                        int(crop[3] * h))
+            return array(np.ascontiguousarray(arr[y0:y1, x0:x1])), new
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding (reference :239): place the image on a
+    larger pad_val canvas, shrinking the boxes accordingly."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super(DetRandomPadAug, self).__init__(
+            aspect_ratio_range=aspect_ratio_range, area_range=area_range,
+            max_attempts=max_attempts, pad_val=pad_val)
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if isinstance(src, NDArray) else src
+        h, w = arr.shape[:2]
+        nh = nw = 0
+        for _ in range(self.max_attempts):
+            # sample an expanded canvas with jittered aspect ratio; it
+            # must contain the source image (reference detection.py:275)
+            area = pyrandom.uniform(*self.area_range) * h * w
+            ratio = pyrandom.uniform(*self.aspect_ratio_range) * (w / h)
+            cand_w = int(np.sqrt(area * ratio))
+            cand_h = int(np.sqrt(area / ratio))
+            if cand_h >= h and cand_w >= w and (cand_h > h or cand_w > w):
+                nh, nw = cand_h, cand_w
+                break
+        if not nh:
+            return src, label
+        oy = pyrandom.randint(0, nh - h)
+        ox = pyrandom.randint(0, nw - w)
+        canvas = np.empty((nh, nw, arr.shape[2]), arr.dtype)
+        canvas[:] = np.asarray(self.pad_val, arr.dtype)
+        canvas[oy:oy + h, ox:ox + w] = arr
+        new = label.copy()
+        new[:, 1] = (new[:, 1] * w + ox) / nw
+        new[:, 3] = (new[:, 3] * w + ox) / nw
+        new[:, 2] = (new[:, 2] * h + oy) / nh
+        new[:, 4] = (new[:, 4] * h + oy) / nh
+        return array(canvas), new
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter pipeline (reference :324)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    # crop and pad are INDEPENDENT stages, each applied with its own
+    # probability (reference detection.py:324 builds one
+    # DetRandomSelectAug per stage)
+    if rand_crop > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                              (area_range[0], min(1.0, area_range[1])),
+                              min_eject_coverage, max_attempts)],
+            1.0 - rand_crop))
+    if rand_pad > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range,
+                             (max(1.0, area_range[0]), area_range[1]),
+                             max_attempts, pad_val)],
+            1.0 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force final shape
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    color_kwargs = dict(brightness=brightness, contrast=contrast,
+                        saturation=saturation, pca_noise=pca_noise,
+                        rand_gray=rand_gray, hue=hue)
+    if any(v for v in color_kwargs.values()) or mean is not None \
+            or std is not None:
+        from . import RandomCropAug, CenterCropAug
+        for aug in CreateAugmenter(data_shape, mean=mean, std=std,
+                                   inter_method=inter_method,
+                                   **color_kwargs):
+            # only color/cast augmenters may be borrowed image-only;
+            # geometry augs would desynchronize boxes from pixels
+            if not isinstance(aug, (ResizeAug, ForceResizeAug,
+                                    RandomCropAug, CenterCropAug)):
+                auglist.append(DetBorrowAug(aug))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator over .rec / .lst (reference detection.py:625).
+
+    Emits labels of shape (batch, max_objects, object_width) with -1
+    padding rows; augmenters receive and transform (image, boxes).
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, imglist=None,
+                 aug_list=None, data_name="data", label_name="label",
+                 **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "pca_noise", "hue",
+                         "inter_method", "min_object_covered",
+                         "aspect_ratio_range", "area_range",
+                         "min_eject_coverage", "max_attempts", "pad_val")})
+        super(ImageDetIter, self).__init__(
+            batch_size=batch_size, data_shape=data_shape,
+            path_imgrec=path_imgrec, path_imglist=path_imglist,
+            path_root=path_root, imglist=imglist, aug_list=[],
+            data_name=data_name, label_name=label_name,
+            **{k: v for k, v in kwargs.items()
+               if k in ("shuffle",)})
+        self._det_auglist = aug_list
+        self.max_objects, self.object_width = self._estimate_label_shape()
+        from ..io import DataDesc
+        self.provide_label = [DataDesc(
+            label_name,
+            (batch_size, self.max_objects, self.object_width))]
+
+    @staticmethod
+    def _parse_label(raw):
+        """Flat [A, B, headers..., objects...] -> (n_obj, B) array."""
+        raw = np.asarray(raw, np.float32).ravel()
+        if raw.size < 2:
+            raise MXNetError("label must start with header_width, "
+                             "object_width")
+        A = int(raw[0])
+        B = int(raw[1])
+        if A < 2 or B < 5:
+            raise MXNetError("invalid detection label header (%d, %d)"
+                             % (A, B))
+        body = raw[A:]
+        if body.size % B != 0:
+            raise MXNetError(
+                "invalid detection label: %d values after the header do "
+                "not divide into %d-wide objects" % (body.size, B))
+        return body.reshape(-1, B)
+
+    def _estimate_label_shape(self):
+        max_objects, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                objs = self._parse_label(label)
+                max_objects = max(max_objects, objs.shape[0])
+                width = max(width, objs.shape[1])
+        except StopIteration:
+            pass
+        self.reset()
+        return max(1, max_objects), width
+
+    def next(self):
+        from ..io import DataBatch
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.full(
+            (self.batch_size, self.max_objects, self.object_width), -1.0,
+            np.float32)
+        i = 0
+        pad = 0
+        while i < self.batch_size:
+            try:
+                raw_label, s = self.next_sample()
+                from . import imdecode
+                img = imdecode(s)
+                objs = self._parse_label(raw_label)
+                for aug in self._det_auglist:
+                    img, objs = aug(img, objs)
+                arr = img.asnumpy() if isinstance(img, NDArray) else img
+                batch_data[i] = np.transpose(arr, (2, 0, 1))
+                n = min(objs.shape[0], self.max_objects)
+                batch_label[i, :n, :objs.shape[1]] = objs[:n]
+                i += 1
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                break
+        return DataBatch(data=[array(batch_data)],
+                         label=[array(batch_label)], pad=pad)

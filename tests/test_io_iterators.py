@@ -100,3 +100,69 @@ def test_ndarray_iter_last_batch_modes():
     assert len(batches) == 3 and batches[-1].pad == 5
     it = mx.io.NDArrayIter(X, batch_size=10, last_batch_handle="discard")
     assert len(list(it)) == 2
+
+
+def _write_det_rec(tmp_path, n=8):
+    rng = np.random.RandomState(4)
+    rec_path = str(tmp_path / "dd.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "dd.idx"), rec_path, "w")
+    for i in range(n):
+        img = (rng.rand(24, 28, 3) * 255).astype("uint8")
+        nobj = 1 + i % 3
+        label = [2, 5] + sum(
+            ([float(i % 4), 0.2, 0.2, 0.7, 0.7] for _ in range(nobj)), [])
+        header = recordio.IRHeader(0, np.asarray(label, "f"), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img))
+    w.close()
+    return rec_path
+
+
+def test_image_det_iter(tmp_path):
+    rec_path = _write_det_rec(tmp_path)
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                               path_imgrec=rec_path)
+    assert it.max_objects == 3 and it.object_width == 5
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 16, 16)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (4, 3, 5)
+    # first object valid, pads -1, coordinates normalized
+    assert (lab[:, 0, 0] >= 0).all()
+    assert ((lab[:, :, 1:] >= -1) & (lab[:, :, 1:] <= 1.0001)).all()
+
+
+def test_det_augmenters_flip_and_crop():
+    from mxnet_tpu.image import detection as det
+    img = mx.nd.array((np.arange(3 * 8 * 8) % 255)
+                      .reshape(8, 8, 3).astype("uint8"))
+    label = np.array([[1, 0.1, 0.2, 0.5, 0.6]], "f")
+    flip = det.DetHorizontalFlipAug(p=1.0)
+    img2, lab2 = flip(img, label)
+    np.testing.assert_allclose(lab2[0, 1], 0.5, atol=1e-6)
+    np.testing.assert_allclose(lab2[0, 3], 0.9, atol=1e-6)
+    crop = det.DetRandomCropAug(min_object_covered=0.5,
+                                area_range=(0.5, 1.0))
+    img3, lab3 = crop(img, label.copy())
+    assert lab3.shape[1] == 5 and lab3.shape[0] >= 1
+    assert (lab3[:, 1:] >= -1e-6).all() and (lab3[:, 1:] <= 1 + 1e-6).all()
+    pad = det.DetRandomPadAug(area_range=(1.5, 2.0))
+    img4, lab4 = pad(img, label.copy())
+    a4 = img4.asnumpy()
+    assert a4.shape[0] >= 8 and a4.shape[1] >= 8
+    assert a4.shape[0] * a4.shape[1] > 64  # canvas expanded
+    w4 = (lab4[0, 3] - lab4[0, 1]) * a4.shape[1]
+    np.testing.assert_allclose(w4, 0.4 * 8, rtol=0.3)  # box pixels kept
+
+
+def test_hue_and_gray_augmenters():
+    from mxnet_tpu import image as img_mod
+    rng = np.random.RandomState(5)
+    src = mx.nd.array((rng.rand(6, 6, 3) * 255).astype("f"))
+    gray = img_mod.RandomGrayAug(p=1.0)(src).asnumpy()
+    # all channels equal after grayscale
+    np.testing.assert_allclose(gray[..., 0], gray[..., 1], rtol=1e-5)
+    hue = img_mod.HueJitterAug(hue=0.3)(src).asnumpy()
+    assert hue.shape == src.shape
+    augs = img_mod.CreateAugmenter((3, 6, 6), hue=0.2, rand_gray=0.5)
+    assert any(isinstance(a, img_mod.HueJitterAug) for a in augs)
+    assert any(isinstance(a, img_mod.RandomGrayAug) for a in augs)
