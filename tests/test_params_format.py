@@ -86,7 +86,8 @@ def test_npz_backcompat(tmp_path):
 
 
 def test_checkpoint_uses_reference_format(tmp_path):
-    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="ckfc")
     mod = mx.mod.Module(net, label_names=None)
     mod.bind([mx.io.DataDesc("data", (2, 5))], None)
     mod.init_params()
@@ -95,7 +96,7 @@ def test_checkpoint_uses_reference_format(tmp_path):
     raw = open(prefix + "-0001.params", "rb").read()
     assert struct.unpack("<Q", raw[:8])[0] == 0x112
     sym, arg, aux = mx.model.load_checkpoint(prefix, 1)
-    assert "fullyconnected0_weight" in arg
+    assert "ckfc_weight" in arg
 
 
 def test_unrepresentable_values_rejected(tmp_path):
