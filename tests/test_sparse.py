@@ -1,0 +1,102 @@
+"""Sparse NDArray suite (reference tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py): storage conversions,
+sparse dot, retain, kvstore row-sparse flows, sparse optimizer ops."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+DENSE = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0], [4, 0, 0]], "f")
+
+
+def test_csr_creation_and_fields():
+    csr = sparse.csr_matrix(DENSE)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.todense().asnumpy(), DENSE)
+    # scipy-style CSR fields
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3, 3, 4])
+    np.testing.assert_allclose(csr.indices.asnumpy(), [1, 0, 2, 0])
+    np.testing.assert_allclose(csr.data.asnumpy(), [1, 2, 3, 4])
+
+
+def test_row_sparse_creation_and_retain():
+    rs = sparse.row_sparse_array(DENSE)
+    assert rs.stype == "row_sparse"
+    np.testing.assert_allclose(rs.indices.asnumpy(), [0, 1, 3])
+    np.testing.assert_allclose(rs.todense().asnumpy(), DENSE)
+    kept = rs.retain(mx.nd.array(np.array([1], "f")))
+    out = kept.todense().asnumpy()
+    np.testing.assert_allclose(out[1], DENSE[1])
+    np.testing.assert_allclose(out[0], 0)
+
+
+def test_cast_storage_roundtrip():
+    dn = mx.nd.array(DENSE)
+    for stype in ("csr", "row_sparse"):
+        sp = sparse.cast_storage(dn, stype)
+        assert sp.stype == stype
+        back = sparse.cast_storage(sp, "default")
+        np.testing.assert_allclose(back.asnumpy(), DENSE)
+
+
+def test_sparse_dot():
+    csr = sparse.csr_matrix(DENSE)
+    rhs = np.random.RandomState(0).rand(3, 5).astype("f")
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), DENSE.dot(rhs), rtol=1e-5)
+
+
+def test_sparse_zeros_and_tostype():
+    z = sparse.zeros("row_sparse", (3, 4))
+    assert z.stype == "row_sparse"
+    np.testing.assert_allclose(z.todense().asnumpy(), 0)
+    dn = mx.nd.array(DENSE)
+    assert dn.tostype("csr").stype == "csr"
+    assert dn.tostype("default") is dn or \
+        np.allclose(dn.tostype("default").asnumpy(), DENSE)
+
+
+def test_kvstore_rowsparse_push_and_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", mx.nd.zeros((4, 3)))
+    kv.push("emb", sparse.row_sparse_array(DENSE))
+    out = mx.nd.zeros((4, 3))
+    kv.pull("emb", out=out)
+    np.testing.assert_allclose(out.asnumpy(), DENSE)
+    rid = mx.nd.array(np.array([1, 3], "f"))
+    kv.row_sparse_pull("emb", out=out, row_ids=rid)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], DENSE[1])
+    np.testing.assert_allclose(got[0], 0)
+
+
+def test_embedding_grad_touches_only_used_rows():
+    """The reference's row-sparse gradient semantics: rows not indexed
+    get zero gradient (so sparse optimizers can skip them)."""
+    w = mx.nd.array(np.random.RandomState(1).rand(10, 4).astype("f"))
+    w.attach_grad()
+    idx = mx.nd.array(np.array([2.0, 5.0, 2.0], "f"))
+    with mx.autograd.record():
+        out = mx.nd.Embedding(idx, w, input_dim=10, output_dim=4)
+        loss = out.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert np.abs(g[2]).sum() > 0 and np.abs(g[5]).sum() > 0
+    untouched = [i for i in range(10) if i not in (2, 5)]
+    np.testing.assert_allclose(g[untouched], 0.0)
+    # row 2 used twice accumulates
+    np.testing.assert_allclose(g[2], 2.0)
+
+
+def test_sparse_sgd_semantics():
+    """lazy_update SGD: zero-grad rows keep their momentum untouched via
+    the sparse adagrad/sgd row-skip convention."""
+    w = mx.nd.ones((3, 2))
+    g = mx.nd.array(np.array([[1, 1], [0, 0], [1, 1]], "f"))
+    h = mx.nd.zeros((3, 2))
+    new_w = mx.nd.sparse_adagrad_update(w, g, h, lr=0.5)
+    nw = new_w.asnumpy()
+    np.testing.assert_allclose(nw[1], 1.0)   # untouched row
+    assert (nw[0] < 1.0).all() and (nw[2] < 1.0).all()
