@@ -10,8 +10,11 @@ from . import dist
 from .data_parallel import DataParallelTrainStep, split_and_load_sharded
 from .ring_attention import (ring_attention, ulysses_attention,
                              local_attention, sequence_sharding)
+from .pipeline import pipeline_apply, stack_stage_params
+from .moe import moe_apply, stack_expert_params
 
-__all__ = ["MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
+__all__ = ["pipeline_apply", "stack_stage_params", "moe_apply", "stack_expert_params",
+           "MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
            "dist", "DataParallelTrainStep", "split_and_load_sharded",
            "ring_attention", "ulysses_attention", "local_attention",
            "sequence_sharding"]

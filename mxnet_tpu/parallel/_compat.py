@@ -1,0 +1,17 @@
+"""Version shim: `shard_map` moved from jax.experimental to jax core and
+renamed its replication-check kwarg (check_rep -> check_vma). One shim,
+shared by ring_attention / pipeline / moe."""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except ImportError:  # older jax: same call, pre-rename kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)

@@ -1,0 +1,86 @@
+"""Expert parallelism: mixture-of-experts over a mesh axis.
+
+Absent from the reference (SURVEY.md §2.8 "Expert parallelism: NO");
+added here as a first-class capability. Experts shard over the ``ep``
+mesh axis; each rank evaluates only its local experts on the tokens
+routed to them (top-k gating with a capacity limit), and contributions
+combine with one ``psum`` over ICI. Everything lives inside one
+`shard_map`-ed, jit-able, differentiable function.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._compat import shard_map
+
+__all__ = ["moe_apply", "stack_expert_params"]
+
+
+def stack_expert_params(per_expert_params):
+    """[expert0_tree, ...] -> one tree stacked on axis 0 (shard over 'ep')."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_expert_params)
+
+
+def moe_apply(expert_fn, expert_params, gate_w, x, mesh, axis="ep",
+              top_k=2, capacity_factor=2.0):
+    """Top-k gated mixture of experts with expert-parallel execution.
+
+    expert_fn(params_e, tokens) -> tokens'  — one expert on (C, D) tokens.
+    expert_params: pytree with leading expert axis (stack_expert_params),
+        sharded over ``axis``; E must divide by the mesh axis size.
+    gate_w: (D, E) router weights (replicated).
+    x: (N, D) tokens (replicated over the ep axis; shard them over a
+        separate dp axis in the caller's in_specs if desired).
+
+    Per-expert capacity C = ceil(top_k * N / E * capacity_factor); tokens
+    routed beyond capacity are dropped (standard switch-style behavior —
+    raise capacity_factor for exactness). Returns (N, D) combined output.
+    """
+    n_ranks = mesh.shape[axis]
+    E = gate_w.shape[1]
+    assert E % n_ranks == 0, "num experts must divide the ep axis size"
+    leading = {l.shape[0] for l in jax.tree_util.tree_leaves(expert_params)}
+    if leading != {E}:
+        raise ValueError(
+            "stacked expert params have leading axis %s but gate_w routes "
+            "to %d experts" % (sorted(leading), E))
+    e_local = E // n_ranks
+    N = x.shape[0]
+    capacity = int(np.ceil(top_k * N / E * capacity_factor))
+    capacity = max(1, min(capacity, N))
+
+    def per_rank(params, gw, xs):
+        rank = lax.axis_index(axis)
+        gates = jax.nn.softmax(xs @ gw, axis=-1)            # (N, E)
+        topv, topi = lax.top_k(gates, top_k)                # (N, k)
+        # combine weight for token n and expert e (0 unless e in top-k)
+        combine = jnp.zeros((N, E), gates.dtype)
+        combine = combine.at[jnp.arange(N)[:, None], topi].set(topv)
+
+        def one_expert(le, out):
+            e = rank * e_local + le
+            w = combine[:, e]                               # (N,)
+            # highest-weight tokens first, up to capacity
+            sel_w, sel_idx = lax.top_k(w, capacity)         # (C,)
+            tokens = xs[sel_idx]                            # (C, D)
+            p_e = jax.tree_util.tree_map(lambda a: a[le], params)
+            h = expert_fn(p_e, tokens)                      # (C, D)
+            h = h * sel_w[:, None]
+            valid = sel_w > 0
+            h = jnp.where(valid[:, None], h, 0.0)
+            return out.at[sel_idx].add(h)
+
+        out = jnp.zeros_like(xs)
+        out = lax.fori_loop(
+            0, e_local, lambda le, o: one_expert(le, o), out)
+        return lax.psum(out, axis)
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), expert_params),
+                P(), P())
+    fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return fn(expert_params, gate_w, x)

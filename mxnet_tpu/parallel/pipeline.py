@@ -1,0 +1,104 @@
+"""Pipeline parallelism over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.8: PartialForward
+is stepwise execution, not pipelining); its closest idiom is manual layer
+placement (`group2ctx`) with cross-device copies. This module supplies
+the real thing, TPU-native: a GPipe-style microbatch schedule where each
+rank of the ``pp`` mesh axis owns one stage's parameters and activations
+hop between neighbors with ``lax.ppermute`` over ICI.
+
+Design: `pipeline_apply(stage_fn, stage_params, x, ...)` runs inside
+`shard_map`; the schedule is a `lax.scan` over ``num_microbatches +
+num_stages - 1`` ticks. At each tick every rank applies its stage to the
+activation it holds and passes the result to the next rank. Differentiable
+(jax.grad flows through ppermute), so one `jax.jit` wraps the full
+pipelined train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._compat import shard_map
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree stacked on axis 0
+    (shard axis 0 over 'pp')."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp"):
+    """Run ``stage_fn`` as a pipeline over the ``axis`` mesh dimension.
+
+    stage_fn(params_i, h) -> h'   — one stage's forward.
+    stacked_params: pytree with leading stage axis (see
+        stack_stage_params), sharded over ``axis``.
+    x: (num_microbatches, micro_batch, ...) input microbatches
+        (replicated; only stage 0 consumes them). The microbatch count is
+        x.shape[0].
+    Returns (num_microbatches, micro_batch, ...) outputs from the final
+    stage (replicated).
+
+    The schedule is the standard GPipe fill/steady/drain loop:
+    T = num_microbatches + num_stages - 1 ticks; rank r computes
+    microbatch t - r at tick t.
+    """
+    n_stages = mesh.shape[axis]
+    leading = {l.shape[0] for l in jax.tree_util.tree_leaves(stacked_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            "stacked stage params have leading axis %s but the '%s' mesh "
+            "axis has %d ranks (one stage per rank)"
+            % (sorted(leading), axis, n_stages))
+    num_microbatches = x.shape[0]
+    T = num_microbatches + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_rank(params, xs):
+        # params: this rank's stage params (leading axis stripped to 1)
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        rank = lax.axis_index(axis)
+        h0 = jnp.zeros_like(xs[0])
+        outs0 = jnp.zeros((num_microbatches,) + xs.shape[1:], xs.dtype)
+
+        def tick(carry, t):
+            h_in, outs = carry
+            # stage 0 injects microbatch t (when in range); other ranks
+            # consume what arrived from the left neighbor
+            mb = jnp.clip(t, 0, num_microbatches - 1)
+            inject = jnp.where(rank == 0,
+                               jnp.where((t >= 0) & (t < num_microbatches),
+                                         1.0, 0.0), 0.0)
+            h = jnp.where(inject > 0, xs[mb], h_in)
+            h = stage_fn(params, h)
+            # last stage records microbatch (t - (n_stages-1)) at tick t
+            out_idx = t - (n_stages - 1)
+            write = (rank == n_stages - 1) & (out_idx >= 0) \
+                & (out_idx < num_microbatches)
+            safe_idx = jnp.clip(out_idx, 0, num_microbatches - 1)
+            outs = jnp.where(
+                write,
+                outs.at[safe_idx].set(h),
+                outs)
+            # pass to the right neighbor for the next tick
+            h_next = lax.ppermute(h, axis, perm)
+            return (h_next, outs), None
+
+        (h_fin, outs), _ = lax.scan(tick, (h0, outs0),
+                                    jnp.arange(T))
+        # replicate the last stage's outputs to every rank
+        outs = lax.psum(
+            jnp.where(rank == n_stages - 1, outs, jnp.zeros_like(outs)),
+            axis)
+        return outs
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                P())
+    fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return fn(stacked_params, x)

@@ -1,0 +1,133 @@
+"""Pipeline (pp) and expert (ep) parallelism vs dense references on the
+virtual 8-device mesh — new capabilities beyond the reference
+(SURVEY.md §2.8 lists both as absent)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from mxnet_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from mxnet_tpu.parallel.moe import moe_apply, stack_expert_params
+
+
+@pytest.fixture
+def pp_mesh():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    return Mesh(np.asarray(devs[:4]), ("pp",))
+
+
+def _stages(rng, n, D):
+    return [{"w": jnp.asarray(rng.randn(D, D).astype("f") * 0.3),
+             "b": jnp.asarray(rng.randn(D).astype("f") * 0.1)}
+            for _ in range(n)]
+
+
+def _stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def test_pipeline_matches_dense(pp_mesh):
+    rng = np.random.RandomState(0)
+    D = 6
+    stages = _stages(rng, 4, D)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.randn(8, 3, D).astype("f"))
+    with pp_mesh:
+        out = pipeline_apply(_stage_fn, stacked, x, pp_mesh, "pp")
+    ref = x
+    for p in stages:
+        ref = _stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_reach_every_stage(pp_mesh):
+    rng = np.random.RandomState(1)
+    D = 4
+    stacked = stack_stage_params(_stages(rng, 4, D))
+    x = jnp.asarray(rng.randn(6, 2, D).astype("f"))
+
+    def loss(stacked, x):
+        with pp_mesh:
+            o = pipeline_apply(_stage_fn, stacked, x, pp_mesh, "pp")
+        return jnp.mean(o * o)
+
+    g = jax.grad(loss)(stacked, x)
+    norms = np.abs(np.asarray(g["w"])).sum(axis=(1, 2))
+    assert (norms > 0).all()
+
+
+def _expert_fn(p, t):
+    return jax.nn.relu(t @ p["w1"]) @ p["w2"]
+
+
+@pytest.fixture
+def ep_mesh():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    return Mesh(np.asarray(devs[:4]), ("ep",))
+
+
+def test_moe_matches_dense_at_full_capacity(ep_mesh):
+    rng = np.random.RandomState(2)
+    N, D, E, K = 16, 8, 8, 2
+    experts = [{"w1": jnp.asarray(rng.randn(D, 16).astype("f") * 0.3),
+                "w2": jnp.asarray(rng.randn(16, D).astype("f") * 0.3)}
+               for _ in range(E)]
+    stacked = stack_expert_params(experts)
+    gate_w = jnp.asarray(rng.randn(D, E).astype("f"))
+    x = jnp.asarray(rng.randn(N, D).astype("f"))
+    with ep_mesh:
+        out = moe_apply(_expert_fn, stacked, gate_w, x, ep_mesh,
+                        top_k=K, capacity_factor=8.0)
+    gates = jax.nn.softmax(x @ gate_w, axis=-1)
+    topv, topi = jax.lax.top_k(gates, K)
+    ref = np.zeros_like(np.asarray(x))
+    for n in range(N):
+        for k in range(K):
+            e = int(topi[n, k])
+            ref[n] += float(topv[n, k]) * np.asarray(
+                _expert_fn(experts[e], x[n:n + 1]))[0]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_and_grads(ep_mesh):
+    rng = np.random.RandomState(3)
+    N, D, E, K = 16, 8, 8, 2
+    stacked = stack_expert_params(
+        [{"w1": jnp.asarray(rng.randn(D, 16).astype("f") * 0.3),
+          "w2": jnp.asarray(rng.randn(16, D).astype("f") * 0.3)}
+         for _ in range(E)])
+    gate_w = jnp.asarray(rng.randn(D, E).astype("f"))
+    x = jnp.asarray(rng.randn(N, D).astype("f"))
+
+    def loss(stacked, gw, x):
+        with ep_mesh:
+            return jnp.mean(moe_apply(_expert_fn, stacked, gw, x, ep_mesh,
+                                      top_k=K, capacity_factor=1.0) ** 2)
+
+    l, g = jax.value_and_grad(loss)(stacked, gate_w, x)
+    assert np.isfinite(float(l))
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.tree_util.tree_leaves(g))
+
+
+def test_shape_validation(pp_mesh):
+    rng = np.random.RandomState(4)
+    wrong = stack_stage_params(_stages(rng, 8, 4))  # 8 stages, 4 ranks
+    x = jnp.ones((4, 2, 4))
+    with pytest.raises(ValueError, match="leading axis"):
+        with pp_mesh:
+            pipeline_apply(_stage_fn, wrong, x, pp_mesh, "pp")
+    experts = stack_expert_params(
+        [{"w1": jnp.ones((4, 4)), "w2": jnp.ones((4, 4))}
+         for _ in range(4)])
+    gate_w = jnp.ones((4, 8))  # routes to 8 experts but only 4 stacked
+    with pytest.raises(ValueError, match="leading axis"):
+        with pp_mesh:
+            moe_apply(_expert_fn, experts, gate_w, jnp.ones((4, 4)),
+                      pp_mesh, axis="pp", top_k=2)
