@@ -61,6 +61,7 @@ def main():
     parser.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(42)  # NDArrayIter shuffle uses the global RNG
 
     rng = np.random.RandomState(1)
     X, y = synth_ctr(rng, args.num_examples, args.num_features, args.active)

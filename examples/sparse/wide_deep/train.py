@@ -65,6 +65,7 @@ def main():
     parser.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(42)  # NDArrayIter shuffle uses the global RNG
 
     rng = np.random.RandomState(2)
     ids, dense, y = synth_census(rng, args.num_examples, args.num_sparse,

@@ -40,8 +40,9 @@ def test_ssd():
 
 
 def test_factorization_machine():
-    r = _run("sparse/factorization_machine/train.py", "--num-epochs", "4",
-             "--num-examples", "1200", "--num-features", "300")
+    r = _run("sparse/factorization_machine/train.py", "--num-epochs", "15",
+             "--num-examples", "2400", "--num-features", "200",
+             "--lr", "0.01")
     assert r.returncode == 0, r.stderr[-2000:]
     acc = float(r.stdout.strip().split()[-1])
     assert acc > 0.6, r.stdout
