@@ -409,6 +409,12 @@ class HybridBlock(Block):
                 return tuple(o._data for o in out)
             return (out._data,)
 
+        if self._flags.get("remat") or self._flags.get("static_alloc") == "remat":
+            # rematerialize activations in backward instead of storing
+            # them — the TPU analog of MXNET_BACKWARD_DO_MIRROR
+            # (docs/architecture/note_memory.md); usage:
+            # net.hybridize(remat=True)
+            traced = jax.checkpoint(traced, static_argnums=(2,))
         self._cached_jit = jax.jit(traced, static_argnums=(2,))
 
     def _collect_all_params(self):

@@ -124,3 +124,34 @@ def test_constant_and_embedding():
     idx = mx.nd.array(np.array([1, 3], "f"))
     out = emb(idx)
     assert out.shape == (2, 4)
+
+
+def test_hybridize_remat_matches_plain():
+    """hybridize(remat=True) rematerializes activations (jax.checkpoint,
+    the MXNET_BACKWARD_DO_MIRROR analog) without changing results."""
+    rng = np.random.RandomState(7)
+    x = mx.nd.array(rng.randn(4, 6).astype("f"))
+
+    results = []
+    for remat in (False, True):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh", in_units=6),
+                nn.Dense(3, in_units=8))
+        net.initialize(mx.init.Xavier(rnd_type="gaussian"))
+        # identical weights across both nets
+        if not results:
+            saved = {k: v.data().asnumpy()
+                     for k, v in net.collect_params().items()}
+            order = list(net.collect_params().keys())
+        else:
+            for k, v in zip(order, net.collect_params().values()):
+                v.set_data(mx.nd.array(saved[k]))
+        net.hybridize(remat=remat)
+        xc = x.copy()
+        xc.attach_grad()
+        with autograd.record():
+            y = net(xc).sum()
+        y.backward()
+        results.append((float(y.asnumpy()), xc.grad.asnumpy()))
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-5)
+    np.testing.assert_allclose(results[0][1], results[1][1], rtol=1e-5)
