@@ -38,6 +38,7 @@ from . import callback
 from . import model
 from .model import FeedForward
 from . import rnn
+from . import executor_manager
 from . import gluon
 from . import image
 from . import profiler

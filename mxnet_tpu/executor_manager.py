@@ -1,0 +1,55 @@
+"""Executor manager helpers (reference python/mxnet/executor_manager.py).
+
+The reference's `DataParallelExecutorManager` slices a batch across GPU
+executors; here data parallelism runs through mesh sharding
+(`mxnet_tpu/parallel`) or the kvstore, so only the slicing helpers —
+still used by user code and `Module` work-load balancing — are provided.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["_split_input_slice", "_load_general", "_load_data",
+           "_load_label"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice the batch according to per-device work loads (reference
+    executor_manager.py:33). Returns a list of slice objects."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("Invalid work load")
+    batch_num_list = [round(work_load * batch_size / total)
+                      for work_load in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise MXNetError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _load_general(data, targets):
+    """Load a list of arrays into a list of (possibly sliced) targets."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, list):
+            for slice_idx, d_dst in d_targets:
+                d_src[slice_idx].copyto(d_dst)
+        else:
+            d_src.copyto(d_targets)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
