@@ -69,3 +69,17 @@ def test_gluon_resnet_tiny():
              "--num-classes", "10", "--num-batches", "2", "--ctx", "cpu")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "img/s" in r.stdout
+
+
+def test_pipeline_mlp():
+    r = _run("model-parallel/pipeline_mlp.py", "--steps", "10",
+             "--micro-batches", "4", "--micro-size", "2", "--hidden", "8")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "pipeline training OK" in r.stdout
+
+
+def test_moe_example():
+    r = _run("moe/train_moe.py", "--steps", "10", "--tokens", "32",
+             "--dim", "8")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "MoE training OK" in r.stdout
