@@ -22,5 +22,31 @@ def zeros_like(a, **kw):
 def ones_like(a, **kw):
     from ..ops.invoke import invoke
     return invoke("ones_like", [a], kw)
+_op_maximum = globals()["maximum"]
+_op_minimum = globals()["minimum"]
+
+
+def maximum(lhs, rhs, **kw):
+    """NDArray/NDArray or NDArray/scalar max (reference ndarray.maximum
+    dispatches to _maximum_scalar for scalar operands)."""
+    from ..ops.invoke import invoke
+    from ..base import numeric_types
+    if isinstance(rhs, numeric_types):
+        return invoke("_maximum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(lhs, numeric_types):
+        return invoke("_maximum_scalar", [rhs], {"scalar": float(lhs)})
+    return _op_maximum(lhs, rhs, **kw)
+
+
+def minimum(lhs, rhs, **kw):
+    from ..ops.invoke import invoke
+    from ..base import numeric_types
+    if isinstance(rhs, numeric_types):
+        return invoke("_minimum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(lhs, numeric_types):
+        return invoke("_minimum_scalar", [rhs], {"scalar": float(lhs)})
+    return _op_minimum(lhs, rhs, **kw)
+
+
 from . import contrib  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401

@@ -327,6 +327,9 @@ class NDArray:
     def dot(self, other, **kw):
         return invoke("dot", [self, other], kw)
 
+    def __matmul__(self, other):
+        return invoke("dot", [self, other], {})
+
     def square(self):
         return invoke("square", [self])
 
