@@ -16,5 +16,28 @@ def ones(shape, dtype=None, **kwargs):
 def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
     return globals()["_arange"](start=start, stop=stop, step=step,
                                 repeat=repeat, dtype=dtype or "float32", **kwargs)
+_op_maximum = globals()["maximum"]
+_op_minimum = globals()["minimum"]
+
+
+def maximum(lhs, rhs, **kw):
+    """Symbol/Symbol or Symbol/scalar max (reference symbol.maximum)."""
+    from ..base import numeric_types
+    if isinstance(rhs, numeric_types):
+        return globals()["_maximum_scalar"](lhs, scalar=float(rhs))
+    if isinstance(lhs, numeric_types):
+        return globals()["_maximum_scalar"](rhs, scalar=float(lhs))
+    return _op_maximum(lhs, rhs, **kw)
+
+
+def minimum(lhs, rhs, **kw):
+    from ..base import numeric_types
+    if isinstance(rhs, numeric_types):
+        return globals()["_minimum_scalar"](lhs, scalar=float(rhs))
+    if isinstance(lhs, numeric_types):
+        return globals()["_minimum_scalar"](rhs, scalar=float(lhs))
+    return _op_minimum(lhs, rhs, **kw)
+
+
 from . import contrib  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401

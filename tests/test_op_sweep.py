@@ -121,3 +121,13 @@ def test_profiler_writes_trace(tmp_path):
     for root, _, files in os.walk(trace_dir):
         found.extend(files)
     assert found, "profiler produced no trace files"
+
+
+def test_symbol_scalar_maximum_minimum():
+    a = mx.sym.Variable("a")
+    ex = mx.sym.maximum(a, 0.5).bind(
+        mx.cpu(), {"a": mx.nd.array(np.array([[0.2, 0.8]], "f"))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [[0.5, 0.8]])
+    ex2 = mx.sym.minimum(0.5, a).bind(
+        mx.cpu(), {"a": mx.nd.array(np.array([[0.2, 0.8]], "f"))})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), [[0.2, 0.5]])
