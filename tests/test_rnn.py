@@ -205,3 +205,48 @@ def test_bucket_iter_empty_bucket():
                                    buckets=[2, 5], invalid_label=-1)
     batches = list(it)
     assert all(b.bucket_key == 2 for b in batches)
+
+
+def test_gluon_contrib_conv_cells():
+    from mxnet_tpu.gluon import contrib as gcontrib
+    for cls, dims, nst in [(gcontrib.rnn.Conv1DRNNCell, 1, 1),
+                           (gcontrib.rnn.Conv2DLSTMCell, 2, 2),
+                           (gcontrib.rnn.Conv3DGRUCell, 3, 1)]:
+        spatial = (6,) * dims
+        cell = cls(input_shape=(3,) + spatial, hidden_channels=4,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.random.uniform(shape=(2, 3) + spatial)
+        states = cell.begin_state(batch_size=2)
+        assert len(states) == nst
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 4) + spatial
+        assert len(new_states) == nst
+        # unroll a short sequence
+        seq = mx.nd.random.uniform(shape=(2, 3, 3) + spatial)
+        outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=False)
+        assert len(outs) == 3
+
+
+def test_gluon_variational_dropout_cell():
+    from mxnet_tpu.gluon import contrib as gcontrib
+    from mxnet_tpu.gluon import rnn as grnn
+    base = grnn.LSTMCell(8, input_size=5)
+    cell = gcontrib.rnn.VariationalDropoutCell(base, drop_inputs=0.3,
+                                               drop_outputs=0.3)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(4, 6, 5))
+    with mx.autograd.record():  # masks active in train mode
+        outs, _ = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (4, 6, 8)
+
+
+def test_gluon_lstmp_cell():
+    from mxnet_tpu.gluon import contrib as gcontrib
+    cell = gcontrib.rnn.LSTMPCell(16, projection_size=8, input_size=4)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(2, 4))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 8)           # projected
+    assert new_states[1].shape == (2, 16)  # cell state full-size
