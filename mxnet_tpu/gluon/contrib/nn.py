@@ -1,10 +1,29 @@
 """Contrib nn blocks (reference python/mxnet/gluon/contrib/nn/basic_layers.py):
-HybridConcurrent (parallel branches, concatenated outputs) and Identity."""
+Concurrent / HybridConcurrent (parallel branches, concatenated outputs)
+and Identity."""
 from __future__ import annotations
 
-from ..block import HybridBlock
+from ..block import Block, HybridBlock
 
-__all__ = ["HybridConcurrent", "Identity"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+
+
+class Concurrent(Block):
+    """Imperative parallel branches, outputs concatenated along `axis`
+    (reference basic_layers.py:27)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        from ... import ndarray as F
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
 
 
 class HybridConcurrent(HybridBlock):

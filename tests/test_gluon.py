@@ -155,3 +155,12 @@ def test_hybridize_remat_matches_plain():
         results.append((float(y.asnumpy()), xc.grad.asnumpy()))
     np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-5)
     np.testing.assert_allclose(results[0][1], results[1][1], rtol=1e-5)
+
+
+def test_contrib_concurrent():
+    from mxnet_tpu.gluon import contrib as gc
+    c = gc.nn.Concurrent(axis=1)
+    c.add(nn.Dense(3), nn.Dense(4))
+    c.initialize()
+    out = c(mx.nd.ones((2, 5)))
+    assert out.shape == (2, 7)
