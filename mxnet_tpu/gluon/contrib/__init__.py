@@ -1,3 +1,4 @@
 """Gluon contrib (reference `python/mxnet/gluon/contrib/`): growing set."""
 from . import rnn
+from . import data
 from . import nn  # noqa: F401

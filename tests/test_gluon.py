@@ -164,3 +164,22 @@ def test_contrib_concurrent():
     c.initialize()
     out = c(mx.nd.ones((2, 5)))
     assert out.shape == (2, 7)
+
+
+def test_contrib_interval_sampler_and_wikitext(tmp_path):
+    from mxnet_tpu.gluon import contrib as gc
+    assert list(gc.data.IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(gc.data.IntervalSampler(13, interval=3, rollover=False)) \
+        == [0, 3, 6, 9, 12]
+    # WikiText from a local file
+    (tmp_path / "wiki.train.tokens").write_text(
+        " hello world foo \n bar hello baz qux \n" * 20)
+    ds = gc.data.WikiText2(root=str(tmp_path), segment="train", seq_len=5)
+    assert len(ds) > 10
+    data, label = ds[0]
+    assert data.shape == (5,) and label.shape == (5,)
+    # label is data shifted by one in the token stream
+    np.testing.assert_allclose(label.asnumpy()[:-1], data.asnumpy()[1:])
+    with pytest.raises(IOError):
+        gc.data.WikiText103(root=str(tmp_path / "nope"))
