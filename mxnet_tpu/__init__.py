@@ -33,6 +33,10 @@ from . import module as mod
 from . import io
 from . import recordio
 from . import kvstore as kv
+from . import kvstore_server
+from . import log
+from . import registry
+from . import libinfo
 from .kvstore import create as kvstore_create
 from . import callback
 from . import model

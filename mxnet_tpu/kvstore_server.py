@@ -1,0 +1,46 @@
+"""KVStore server bootstrap (reference python/mxnet/kvstore_server.py).
+
+The reference launches parameter-server processes that block in
+`KVStoreServer.run`. This framework is server-free — gradient sync is
+collective (SURVEY.md §5) — so the API is preserved for launcher
+compatibility: a "server" role process simply joins the jax.distributed
+cluster and waits until the workers finish.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """API-compatible server shell (reference kvstore_server.py:28)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self):
+        def server_controller(cmd_id, cmd_body):
+            # reference commands: kStopServer/kSyncMode/kSetGradientCompression
+            if cmd_id == 1 and "compress" in str(cmd_body):
+                self.kvstore.set_gradient_compression(
+                    {"type": "2bit"})
+        return server_controller
+
+    def run(self):
+        """Block like a PS server would: join the collective cluster and
+        barrier until the workers' run completes."""
+        from .parallel import dist
+        dist.init()
+        dist.barrier()
+
+
+def _init_kvstore_server_module():
+    """Reference entry: start a server when DMLC_ROLE=server. Collective
+    backends have no server role; worker/scheduler roles return."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        from . import kvstore
+        server = KVStoreServer(kvstore.create("dist_sync"))
+        server.run()

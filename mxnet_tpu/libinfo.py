@@ -1,0 +1,22 @@
+"""Library locator (reference python/mxnet/libinfo.py).
+
+The native runtime is `libmxtpu.so` built from `src/` (see
+`mxnet_tpu/_native.py`); find_lib_path returns its path when built."""
+from __future__ import annotations
+
+import os
+
+from .base import __version__  # noqa: F401
+
+__all__ = ["find_lib_path", "__version__"]
+
+
+def find_lib_path():
+    """Paths to the native runtime library (empty if not built)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(pkg, "native", "libmxtpu.so"),
+        os.path.join(repo_root, "src", "libmxtpu.so"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]

@@ -1,0 +1,65 @@
+"""Colored logging helpers (reference python/mxnet/log.py)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["getLogger", "get_logger"]
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+
+class _Formatter(logging.Formatter):
+    """Per-level colored prefixes when attached to a tty
+    (reference log.py:37)."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _color(self, level):
+        if level == logging.WARNING:
+            return "\x1b[0;33m%s\x1b[0m"
+        if level == logging.ERROR:
+            return "\x1b[0;31m%s\x1b[0m"
+        return "%s"
+
+    def format(self, record):
+        fmt = self._color(record.levelno) if self.colored else "%s"
+        head = fmt % record.levelname[0]
+        self._style._fmt = head + "%(asctime)s %(process)d %(pathname)s:%(lineno)d] %(message)s"
+        return super().format(record)
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias of get_logger (reference log.py:80)."""
+    import warnings
+    warnings.warn("getLogger is deprecated, use get_logger instead",
+                  DeprecationWarning)
+    return get_logger(name, filename, filemode, level)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Logger with the framework's colored formatter (reference log.py:90)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", None):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+            hdlr.setFormatter(_Formatter(colored=False))
+        else:
+            hdlr = logging.StreamHandler()
+            hdlr.setFormatter(_Formatter(
+                colored=hasattr(sys.stderr, "isatty")
+                and sys.stderr.isatty()))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger

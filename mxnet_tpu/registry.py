@@ -1,0 +1,96 @@
+"""Generic object-registry helpers (reference python/mxnet/registry.py):
+register/alias/create function factories used by Optimizer, Initializer
+and user extension points."""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import string_types
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_REGISTRY = {}
+
+
+def get_registry(base_class):
+    """Copy of the registry for a base class (reference registry.py:32)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    return dict(_REGISTRY[base_class])
+
+
+def get_register_func(base_class, nickname):
+    """Make a register() decorator for subclasses of base_class
+    (reference registry.py:49)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "Can only register subclass of %s" % base_class.__name__
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in registry:
+            warnings.warn(
+                "New %s %s.%s registered with name %s is overriding "
+                "existing %s %s.%s" % (
+                    nickname, klass.__module__, klass.__name__, name,
+                    nickname, registry[name].__module__,
+                    registry[name].__name__), UserWarning)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (
+        base_class.__name__, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Make an alias() decorator (reference registry.py:88)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Make a create(name_or_instance, **kwargs) factory
+    (reference registry.py:115)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def create(*args, **kwargs):
+        if len(args):
+            name = args[0]
+            args = args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            assert len(args) == 0 and len(kwargs) == 0, \
+                "%s is already an instance. Additional arguments are " \
+                "invalid" % nickname
+            return name
+        if isinstance(name, string_types):
+            if name.startswith("["):
+                assert not args and not kwargs
+                name, kwargs = json.loads(name)
+                return create(name, **kwargs)
+            if name.lower() not in registry:
+                raise ValueError("%s is not registered. Please register "
+                                 "with %s.register first" % (name, nickname))
+            return registry[name.lower()](*args, **kwargs)
+        raise ValueError("%s must be of string or %s instance"
+                         % (nickname, base_class.__name__))
+
+    create.__doc__ = "Create a %s instance from config" % nickname
+    return create

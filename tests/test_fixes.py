@@ -166,3 +166,30 @@ def test_hard_reset_drops_roll_over_cache():
     it.reset()
     first = next(it)
     assert first.data[0].asnumpy()[0, 0] == 0.0
+
+
+def test_small_parity_modules():
+    """kvstore_server/log/registry/libinfo exist with reference APIs."""
+    import warnings
+    import mxnet_tpu as mx
+    assert mx.libinfo.find_lib_path(), "native lib should be discoverable"
+    lg = mx.log.get_logger("parity_test", level=mx.log.INFO)
+    lg.info("hello")
+
+    class Base:
+        pass
+
+    class Impl(Base):
+        pass
+    reg = mx.registry.get_register_func(Base, "thing")
+    reg(Impl)
+    create = mx.registry.get_create_func(Base, "thing")
+    assert isinstance(create("impl"), Impl)
+    assert isinstance(create(Impl()), Impl)
+    alias = mx.registry.get_alias_func(Base, "thing")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        alias("impl2")(Impl)
+    assert isinstance(create("impl2"), Impl)
+    srv = mx.kvstore_server.KVStoreServer(mx.kv.create("local"))
+    assert callable(srv._controller())
