@@ -83,3 +83,9 @@ def test_moe_example():
              "--dim", "8")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "MoE training OK" in r.stdout
+
+
+def test_faster_rcnn():
+    r = _run("rcnn/train_faster_rcnn.py", "--num-steps", "20")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FASTER-RCNN FLOW OK" in r.stdout
