@@ -44,6 +44,10 @@ def main():
     parser.add_argument("--num-steps", type=int, default=40)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--deformable", action="store_true",
+                        help="use DeformableConvolution in the head conv "
+                             "and DeformablePSROIPooling for roi features "
+                             "(the fork's Deformable ConvNets workflow)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     rng = np.random.RandomState(0)
@@ -64,13 +68,29 @@ def main():
     rpn_cls = gluon.nn.Conv2D(2 * num_anchors, 1)
     rpn_bbox = gluon.nn.Conv2D(4 * num_anchors, 1)
     rcnn_fc = gluon.nn.Dense(64, activation="relu")
+    if args.deformable:
+        # learned offsets for a 3x3 deformable conv on the feature map
+        offset_conv = gluon.nn.Conv2D(2 * 9, 3, padding=1,
+                                      weight_initializer="zeros")
+        deform_weight = gluon.Parameter("deform_weight",
+                                        shape=(32, 32, 3, 3))
+        deform_weight.initialize(mx.init.Xavier())
+        # per-roi deformation offsets for PSROI pooling (no_trans head)
+        psroi_dim = 8
+        psroi_conv = gluon.nn.Conv2D(psroi_dim * 4 * 4, 1)
+    else:
+        offset_conv = deform_weight = psroi_conv = None
     rcnn_cls = gluon.nn.Dense(num_classes)
     rcnn_bbox = gluon.nn.Dense(num_classes * 4)
     blocks = [backbone, rpn_cls, rpn_bbox, rcnn_fc, rcnn_cls, rcnn_bbox]
+    if args.deformable:
+        blocks += [offset_conv, psroi_conv]
     params = []
     for b in blocks:
         b.initialize()
         params += list(b.collect_params().values())
+    if args.deformable:
+        params.append(deform_weight)
     trainer = gluon.Trainer(params, "adam", {"learning_rate": args.lr})
     ce = gluon.loss.SoftmaxCrossEntropyLoss()
 
@@ -97,8 +117,21 @@ def main():
                 batch_images=args.batch_size,
                 batch_rois=args.batch_size * 16, fg_fraction=0.5,
                 fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0)
-            pooled = mx.nd.ROIPooling(feat, samp_rois, pooled_size=(4, 4),
-                                      spatial_scale=1.0 / stride)
+            if args.deformable:
+                offsets = offset_conv(feat)
+                feat = mx.nd.contrib.DeformableConvolution(
+                    feat, offsets, deform_weight.data(), kernel=(3, 3),
+                    pad=(1, 1), num_filter=32, no_bias=True)
+                feat = mx.nd.relu(feat)
+                ps_feat = psroi_conv(feat)
+                pooled = mx.nd.contrib.DeformablePSROIPooling(
+                    ps_feat, samp_rois, spatial_scale=1.0 / stride,
+                    output_dim=8, pooled_size=4, group_size=4,
+                    no_trans=True)[0]
+            else:
+                pooled = mx.nd.ROIPooling(
+                    feat, samp_rois, pooled_size=(4, 4),
+                    spatial_scale=1.0 / stride)
             hid = rcnn_fc(pooled.reshape((pooled.shape[0], -1)))
             cls_logits = rcnn_cls(hid)
             bbox_pred = rcnn_bbox(hid)

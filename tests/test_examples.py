@@ -89,3 +89,10 @@ def test_faster_rcnn():
     r = _run("rcnn/train_faster_rcnn.py", "--num-steps", "20")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "FASTER-RCNN FLOW OK" in r.stdout
+
+
+def test_deformable_rcnn():
+    r = _run("rcnn/train_faster_rcnn.py", "--num-steps", "15",
+             "--deformable")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FASTER-RCNN FLOW OK" in r.stdout
