@@ -75,9 +75,11 @@ def main():
         deform_weight = gluon.Parameter("deform_weight",
                                         shape=(32, 32, 3, 3))
         deform_weight.initialize(mx.init.Xavier())
-        # per-roi deformation offsets for PSROI pooling (no_trans head)
-        psroi_dim = 8
-        psroi_conv = gluon.nn.Conv2D(psroi_dim * 4 * 4, 1)
+        # position-sensitive score maps: output_dim * group_size^2
+        # channels, consumed by the no_trans PSROI head (zero deformation;
+        # pass a trans input + trans_std > 0 for the full deformable head)
+        psroi_dim, psroi_group = 8, 4
+        psroi_conv = gluon.nn.Conv2D(psroi_dim * psroi_group ** 2, 1)
     else:
         offset_conv = deform_weight = psroi_conv = None
     rcnn_cls = gluon.nn.Dense(num_classes)
@@ -126,8 +128,8 @@ def main():
                 ps_feat = psroi_conv(feat)
                 pooled = mx.nd.contrib.DeformablePSROIPooling(
                     ps_feat, samp_rois, spatial_scale=1.0 / stride,
-                    output_dim=8, pooled_size=4, group_size=4,
-                    no_trans=True)[0]
+                    output_dim=psroi_dim, pooled_size=psroi_group,
+                    group_size=psroi_group, no_trans=True)[0]
             else:
                 pooled = mx.nd.ROIPooling(
                     feat, samp_rois, pooled_size=(4, 4),
