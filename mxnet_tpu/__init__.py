@@ -12,6 +12,8 @@ SURVEY.md §7 for the design mapping.
 from .base import MXNetError, __version__
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import engine
+from . import storage
+from . import resource
 from . import random
 from .random import seed
 from . import ndarray
@@ -53,6 +55,8 @@ from .monitor import Monitor
 from . import test_utils
 from . import parallel
 from . import rtc
+from . import predict
+from .predict import Predictor
 from . import operator
 from . import contrib
 from .attribute import AttrScope

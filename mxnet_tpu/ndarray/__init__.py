@@ -1,7 +1,7 @@
 """`mx.nd` namespace (reference `python/mxnet/ndarray/`)."""
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concatenate, moveaxis, waitall)
-from .utils import save, load
+from .utils import save, load, load_frombuffer
 from . import random
 from . import sparse
 from . import register as _register

@@ -26,7 +26,7 @@ import numpy as np
 from ..base import MXNetError, _DTYPE_NP_TO_MX, _DTYPE_MX_TO_NP
 from .ndarray import NDArray, array
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "load_frombuffer"]
 
 _LIST_KEY = "__mx_tpu_list__"
 _LIST_MAGIC = 0x112
@@ -133,25 +133,44 @@ def save(fname, data):
             f.write(kb)
 
 
+def _load_container(f, ctx):
+    """Parse the reference list container from an open binary stream
+    (header magic already consumed and verified by the caller)."""
+    _read_exact(f, 8)  # reserved
+    count = struct.unpack("<Q", _read_exact(f, 8))[0]
+    arrs = [_read_nd(f) for _ in range(count)]
+    n_names = struct.unpack("<Q", _read_exact(f, 8))[0]
+    names = []
+    for _ in range(n_names):
+        ln = struct.unpack("<Q", _read_exact(f, 8))[0]
+        names.append(_read_exact(f, ln).decode("utf-8"))
+    if names and len(names) != len(arrs):
+        raise MXNetError("Invalid NDArray file format")
+    nds = [array(a, ctx=ctx, dtype=a.dtype) for a in arrs]
+    if names:
+        return dict(zip(names, nds))
+    return nds
+
+
+def load_frombuffer(buf, ctx=None):
+    """Load NDArrays from in-memory ``bytes`` in the reference container
+    format (reference C API ``MXNDListCreate``,
+    src/c_api/c_predict_api.cc — the predict-API path that reads a
+    .params blob without touching the filesystem)."""
+    import io as _io
+    f = _io.BytesIO(buf)
+    head = f.read(8)
+    if len(head) != 8 or struct.unpack("<Q", head)[0] != _LIST_MAGIC:
+        raise MXNetError("buffer is not in the NDArray list format")
+    return _load_container(f, ctx)
+
+
 def load(fname, ctx=None):
     """Load NDArrays saved by `save` or by the reference framework."""
     with open(fname, "rb") as f:
         head = f.read(8)
         if len(head) == 8 and struct.unpack("<Q", head)[0] == _LIST_MAGIC:
-            _read_exact(f, 8)  # reserved
-            count = struct.unpack("<Q", _read_exact(f, 8))[0]
-            arrs = [_read_nd(f) for _ in range(count)]
-            n_names = struct.unpack("<Q", _read_exact(f, 8))[0]
-            names = []
-            for _ in range(n_names):
-                ln = struct.unpack("<Q", _read_exact(f, 8))[0]
-                names.append(_read_exact(f, ln).decode("utf-8"))
-            if names and len(names) != len(arrs):
-                raise MXNetError("Invalid NDArray file format")
-            nds = [array(a, ctx=ctx, dtype=a.dtype) for a in arrs]
-            if names:
-                return dict(zip(names, nds))
-            return nds
+            return _load_container(f, ctx)
     # fall back to the earlier .npz container
     with np.load(fname, allow_pickle=False) as npz:
         keys = list(npz.keys())

@@ -1,0 +1,170 @@
+"""Minimal inference ("predict") API.
+
+Parity with the reference's standalone predict C API
+(`include/mxnet/c_predict_api.h`, impl `src/c_api/c_predict_api.cc`) used
+by the amalgamation/mobile builds: create a predictor from a symbol JSON
+string plus a `.params` blob, set inputs by name, run forward, read
+outputs — no training machinery in the loop. Method-for-function mapping:
+
+==========================  =================================
+reference C function         :class:`Predictor` method
+==========================  =================================
+``MXPredCreate``             ``Predictor(...)``
+``MXPredCreatePartialOut``   ``Predictor(..., output_names=[...])``
+``MXPredReshape``            ``Predictor.reshape``
+``MXPredGetOutputShape``     ``Predictor.get_output_shape``
+``MXPredSetInput``           ``Predictor.set_input``
+``MXPredForward``            ``Predictor.forward``
+``MXPredGetOutput``          ``Predictor.get_output``
+``MXPredFree``               ``Predictor.close`` / del
+``MXNDListCreate``           ``mx.nd.load_frombuffer``
+==========================  =================================
+
+TPU-native: the bound executor jits the whole graph into one XLA program
+per input-shape signature (reference CachedOp lesson), so repeated
+``forward`` calls are single dispatches; ``reshape`` re-binds sharing the
+same parameter NDArrays like the reference's shared-buffer rebind.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu
+from . import ndarray as nd
+from .symbol import symbol as _symbol
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Inference-only executor over (symbol JSON, params blob).
+
+    Parameters
+    ----------
+    symbol_json : str
+        Symbol JSON (reference `symbol_json_str` arg of MXPredCreate).
+    param_bytes : bytes or str or dict
+        The `.params` container as in-memory bytes, a file path, or an
+        already-loaded ``{'arg:name'/'aux:name' -> NDArray}`` dict.
+    ctx : Context
+        Device (reference dev_type/dev_id pair).
+    input_shapes : dict[str, tuple]
+        Shapes for every data input (reference input_keys/input_shape
+        csr arrays).
+    output_names : list[str], optional
+        Bind only these internal outputs (MXPredCreatePartialOut).
+    """
+
+    def __init__(self, symbol_json, param_bytes, ctx=None, input_shapes=None,
+                 output_names=None):
+        self._ctx = ctx if ctx is not None else cpu()
+        if not isinstance(self._ctx, Context):
+            raise MXNetError("ctx must be a Context")
+        sym = _symbol.load_json(symbol_json)
+        if output_names:
+            outs = []
+            internals = sym.get_internals()
+            for name in output_names:
+                key = name if name.endswith("_output") else name + "_output"
+                outs.append(internals[key])
+            sym = _symbol.Group(outs) if len(outs) > 1 else outs[0]
+        self._symbol = sym
+        self._params = self._load_params(param_bytes)
+        self._input_shapes = dict(input_shapes or {})
+        self._exec = None
+        self._bind()
+
+    @staticmethod
+    def _load_params(param_bytes):
+        if isinstance(param_bytes, dict):
+            raw = param_bytes
+        elif isinstance(param_bytes, (bytes, bytearray, memoryview)):
+            raw = nd.load_frombuffer(bytes(param_bytes))
+        elif isinstance(param_bytes, str):
+            raw = nd.load(param_bytes)
+        else:
+            raise MXNetError("param_bytes must be bytes, a path, or a dict")
+        if not isinstance(raw, dict):
+            raise MXNetError(".params blob must carry names "
+                             "(saved as a dict)")
+        params = {}
+        for k, v in raw.items():
+            # reference predict api accepts both prefixed and bare names
+            # (c_predict_api.cc strips "arg:"/"aux:")
+            if k.startswith("arg:") or k.startswith("aux:"):
+                params[k.split(":", 1)[1]] = v
+            else:
+                params[k] = v
+        return params
+
+    def _bind(self):
+        shapes = dict(self._input_shapes)
+        for name in self._symbol.list_arguments():
+            if name in self._params and name not in shapes:
+                shapes[name] = self._params[name].shape
+        ex = self._symbol.simple_bind(self._ctx, grad_req="null", **shapes)
+        for name, arr in self._params.items():
+            if name in ex.arg_dict:
+                ex.arg_dict[name][:] = arr
+            elif name in ex.aux_dict:
+                ex.aux_dict[name][:] = arr
+        self._exec = ex
+
+    # ------------------------------------------------------------------
+    def set_input(self, name, data):
+        """MXPredSetInput: copy host data into the named input."""
+        if name not in self._exec.arg_dict:
+            raise MXNetError("no input named %r; arguments are %s"
+                             % (name, self._symbol.list_arguments()))
+        data = np.asarray(data, dtype=self._exec.arg_dict[name].dtype)
+        if tuple(data.shape) != self._exec.arg_dict[name].shape:
+            raise MXNetError(
+                "input %r shape %s != bound shape %s (use reshape())"
+                % (name, tuple(data.shape), self._exec.arg_dict[name].shape))
+        self._exec.arg_dict[name][:] = data
+
+    def forward(self, **inputs):
+        """MXPredForward; keyword inputs are a convenience for
+        set_input + forward in one call."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._exec.forward(is_train=False)
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape."""
+        if self._exec.outputs:
+            return tuple(self._exec.outputs[index].shape)
+        return tuple(self._symbol.infer_shape(**self._all_shapes())[1][index])
+
+    def _all_shapes(self):
+        shapes = dict(self._input_shapes)
+        for name, arr in self._params.items():
+            shapes.setdefault(name, arr.shape)
+        return shapes
+
+    def get_output(self, index=0):
+        """MXPredGetOutput: returns a host numpy array."""
+        if not self._exec.outputs:
+            raise MXNetError("call forward() before get_output()")
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._symbol.list_outputs())
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: rebind for new input shapes sharing the loaded
+        parameters (no reload, no recopy of weights)."""
+        self._input_shapes.update(input_shapes)
+        self._bind()
+
+    def close(self):
+        """MXPredFree."""
+        self._exec = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,179 @@
+"""Resource manager: per-device temp workspace and RNG resources.
+
+Parity with the reference resource layer (`include/mxnet/resource.h:38-46`
+``ResourceRequest::{kRandom, kTempSpace, kParallelRandom}``;
+implementation `src/resource.cc:87-140`; pool size knob
+``MXNET_EXEC_NUM_TEMP``). In the reference, ops declare resource requests
+and the executor attaches pooled per-device resources
+(`src/executor/attach_op_resource_pass.cc`).
+
+TPU-native mapping:
+
+- **kTempSpace** — XLA plans scratch memory itself, so a device temp
+  workspace is an accounting object: ``Resource.get_space(shape)`` hands out
+  a host-pooled staging buffer (backed by :mod:`mxnet_tpu.storage`) for ops
+  that marshal on the host (IO, custom ops); device-side scratch needs no
+  framework help.
+- **kRandom / kParallelRandom** — the per-device mshadow RNG
+  (`src/common/random_generator.h`) becomes a named counter-based PRNG
+  stream: each resource owns an independent fold of the root key from
+  :mod:`mxnet_tpu.random`, reseedable via ``mx.random.seed`` semantics.
+  ``kParallelRandom`` returns a *vector* of keys (the reference hands
+  kernels N parallel sampler states).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import jax
+
+from .base import MXNetError
+from .context import Context, current_context
+from . import random as _random
+from . import storage as _storage
+
+__all__ = ["ResourceRequest", "Resource", "ResourceManager", "request"]
+
+
+class ResourceRequest:
+    """Reference ``ResourceRequest::Type`` (resource.h:38-46)."""
+
+    kRandom = "random"
+    kTempSpace = "temp_space"
+    kParallelRandom = "parallel_random"
+
+    def __init__(self, type_):
+        if type_ not in (self.kRandom, self.kTempSpace, self.kParallelRandom):
+            raise MXNetError("unknown resource request type %r" % (type_,))
+        self.type = type_
+
+    def __repr__(self):
+        return "ResourceRequest(%s)" % self.type
+
+
+class Resource:
+    """A granted resource (reference ``Resource``, resource.h:58+)."""
+
+    def __init__(self, req, ctx, slot):
+        self.req = req
+        self.ctx = ctx
+        self._slot = slot
+        self._lock = threading.Lock()
+        self._key = None
+        self._space = None
+
+    # -- kTempSpace ----------------------------------------------------
+    def get_space(self, shape, dtype=np.float32):
+        """Host staging scratch of at least ``shape`` elements; reuses one
+        growing pooled block per resource like the reference's per-resource
+        workspace (resource.cc kTempSpace)."""
+        if self.req.type != ResourceRequest.kTempSpace:
+            raise MXNetError("get_space on a %s resource" % self.req.type)
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        with self._lock:
+            if self._space is None or self._space.size < nbytes:
+                if self._space is not None:
+                    _storage.free(self._space)
+                self._space = _storage.alloc(nbytes, self.ctx)
+            view = self._space.dptr[:nbytes].view(dtype)
+        return view.reshape(shape)
+
+    # -- kRandom -------------------------------------------------------
+    def _ensure_key(self):
+        if self._key is None:
+            # independent stream per (ctx, slot): fold the slot id into the
+            # root key so streams never collide with eager sampling
+            self._key = jax.random.fold_in(
+                _random.get_key(self.ctx),
+                (hash((self.ctx.device_typeid, self.ctx.device_id,
+                       self._slot)) & 0x7FFFFFFF))
+
+    def next_key(self):
+        """Fresh subkey from this resource's private stream (reference: the
+        op-visible per-device sampler, random_generator.h)."""
+        if self.req.type not in (ResourceRequest.kRandom,
+                                 ResourceRequest.kParallelRandom):
+            raise MXNetError("next_key on a %s resource" % self.req.type)
+        with self._lock:
+            self._ensure_key()
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def parallel_keys(self, n):
+        """n independent keys (reference kParallelRandom hands kernels a
+        vector of sampler states)."""
+        if self.req.type != ResourceRequest.kParallelRandom:
+            raise MXNetError("parallel_keys on a %s resource" % self.req.type)
+        with self._lock:
+            self._ensure_key()
+            self._key, sub = jax.random.split(self._key)
+        return jax.random.split(sub, n)
+
+    def seed(self, seed_val):
+        """Reseed this resource's stream (reference SeedRandom,
+        resource.cc). Folds in (ctx, slot) like first-use initialization so
+        reseeded pool members stay decorrelated from each other."""
+        with self._lock:
+            self._key = jax.random.fold_in(
+                jax.random.PRNGKey(int(seed_val)),
+                (hash((self.ctx.device_typeid, self.ctx.device_id,
+                       self._slot)) & 0x7FFFFFFF))
+
+
+class ResourceManager:
+    """Per-context resource pools (reference ResourceManagerImpl,
+    src/resource.cc:87: a fixed-size rotating pool of temp-space and RNG
+    resources per device; pool size = ``MXNET_EXEC_NUM_TEMP``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pools = {}   # (ctx, type) -> [Resource]
+        self._next = {}    # (ctx, type) -> rotation index
+
+    @property
+    def num_temp(self):
+        return max(1, int(os.environ.get("MXNET_EXEC_NUM_TEMP", "1")))
+
+    def request(self, ctx, req):
+        """Grant a resource, rotating through the per-device pool like the
+        reference's round-robin attachment (resource.cc Request)."""
+        if not isinstance(req, ResourceRequest):
+            req = ResourceRequest(req)
+        if not isinstance(ctx, Context):
+            raise MXNetError("ctx must be a Context, got %r" % (ctx,))
+        pool_key = ((ctx.device_typeid, ctx.device_id), req.type)
+        size = self.num_temp if req.type == ResourceRequest.kTempSpace else 2
+        with self._lock:
+            pool = self._pools.setdefault(pool_key, [])
+            while len(pool) < size:
+                pool.append(Resource(ResourceRequest(req.type), ctx,
+                                     slot=len(pool)))
+            i = self._next.get(pool_key, 0)
+            self._next[pool_key] = (i + 1) % size
+            return pool[i]
+
+    def seed_all(self, seed_val, ctx="all"):
+        """Reseed every granted RNG resource (reference
+        ResourceManager::SeedRandom, called from mx.random.seed); ctx other
+        than 'all' restricts to that device's pools."""
+        with self._lock:
+            resources = [r for pool in self._pools.values() for r in pool]
+        for r in resources:
+            if r.req.type == ResourceRequest.kTempSpace:
+                continue
+            if ctx != "all" and isinstance(ctx, Context) and r.ctx != ctx:
+                continue
+            r.seed(seed_val)
+
+
+_manager = ResourceManager()
+
+
+def request(req, ctx=None):
+    """Module-level convenience: grant a resource on ``ctx`` (defaults to
+    the current context)."""
+    return _manager.request(ctx if ctx is not None else current_context(),
+                            req)

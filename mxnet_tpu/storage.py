@@ -1,0 +1,208 @@
+"""Storage manager: allocation pools and device memory accounting.
+
+Parity with the reference's storage layer (`include/mxnet/storage.h:36-101`
+``Storage::Alloc/Free/DirectFree``, ``src/storage/pooled_storage_manager.h:48``
+GPU pooled caching allocator, ``src/storage/storage.cc`` singleton dispatch
+per Context). TPU-native mapping:
+
+- **Device memory** is owned by PJRT/XLA — the framework never mallocs HBM
+  directly, so ``Storage`` on an accelerator context is an *accounting*
+  surface: `device_memory_info(ctx)` reports the chip's HBM occupancy
+  (reference analog: ``mx.context.gpu_memory_info`` /
+  ``cudaMemGetInfo``), and the per-context stats counters mirror the
+  reference's GPU-memory profiler (`src/profiler/storage_profiler.h`).
+- **Host staging memory** is where a real pooled allocator still earns its
+  keep on TPU: the IO pipeline stages batches in host buffers before the
+  device put. ``Storage.alloc(size, cpu())`` returns a pooled, size-bucketed
+  numpy-backed ``Handle`` exactly like the reference's
+  ``PooledStorageManager`` (round-up to power-of-two size classes, freed
+  blocks cached for reuse, ``release_all`` drops the cache). The
+  ``MXNET_MEM_POOL_ROUND_LINEAR_CUTOFF`` analog is the pow2 rounding cutoff
+  and ``MXNET_HOST_MEM_POOL_RESERVE`` caps the cached bytes (reference env:
+  ``MXNET_GPU_MEM_POOL_RESERVE``, pooled_storage_manager.h).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+
+__all__ = ["Handle", "Storage", "alloc", "free", "direct_free",
+           "release_all", "pool_stats", "device_memory_info"]
+
+
+class Handle:
+    """Reference ``Storage::Handle`` (storage.h:44-77): an opaque chunk with
+    a base pointer, requested size, and owning context. ``dptr`` is the
+    numpy view of exactly the requested size (the pooled block behind it may
+    be larger, like the rounded allocations in pooled_storage_manager.h)."""
+
+    __slots__ = ("dptr", "size", "ctx", "_block", "_freed")
+
+    def __init__(self, dptr, size, ctx, block):
+        self.dptr = dptr
+        self.size = size
+        self.ctx = ctx
+        self._block = block
+        self._freed = False
+
+    def __repr__(self):
+        return "Handle(size=%d, ctx=%s%s)" % (
+            self.size, self.ctx, ", freed" if self._freed else "")
+
+
+def _round_size(size):
+    """Power-of-two size classes (pooled_storage_manager.h rounding), with a
+    4KB floor so tiny allocs share buckets."""
+    if size <= 4096:
+        return 4096
+    return 1 << (size - 1).bit_length()
+
+
+class _HostPool:
+    """Pooled host staging allocator: freed blocks are cached per size
+    class for reuse (reference GPU memory pool,
+    pooled_storage_manager.h:48). Thread-safe like the reference's
+    mutex-guarded manager."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = {}          # rounded size -> [np buffers]
+        self._cached_bytes = 0
+        self.num_allocs = 0
+        self.pool_hits = 0
+        self.bytes_allocated = 0
+
+    @property
+    def reserve_bytes(self):
+        # cap on cached bytes; reference reserves a % of device memory
+        # (MXNET_GPU_MEM_POOL_RESERVE); for host staging an absolute cap in
+        # MB is the useful knob
+        return int(os.environ.get("MXNET_HOST_MEM_POOL_RESERVE", "256")) << 20
+
+    def alloc(self, size):
+        rounded = _round_size(size)
+        with self._lock:
+            self.num_allocs += 1
+            self.bytes_allocated += size
+            bucket = self._free.get(rounded)
+            if bucket:
+                buf = bucket.pop()
+                self._cached_bytes -= rounded
+                self.pool_hits += 1
+            else:
+                buf = np.empty(rounded, dtype=np.uint8)
+        return buf
+
+    def free(self, buf):
+        rounded = buf.nbytes
+        with self._lock:
+            if self._cached_bytes + rounded <= self.reserve_bytes:
+                self._free.setdefault(rounded, []).append(buf)
+                self._cached_bytes += rounded
+            # else: drop it; python GC is the DirectFree
+
+    def release_all(self):
+        with self._lock:
+            self._free.clear()
+            self._cached_bytes = 0
+
+    def stats(self):
+        with self._lock:
+            return {
+                "num_allocs": self.num_allocs,
+                "pool_hits": self.pool_hits,
+                "bytes_allocated": self.bytes_allocated,
+                "cached_bytes": self._cached_bytes,
+                "cached_blocks": sum(len(v) for v in self._free.values()),
+            }
+
+
+_pool = _HostPool()
+
+
+class Storage:
+    """Singleton facade (reference ``Storage::Get()``, storage.cc)."""
+
+    @staticmethod
+    def alloc(size, ctx=None):
+        """Allocate ``size`` bytes on ``ctx``; returns a :class:`Handle`.
+
+        Host contexts use the pooled staging allocator. Accelerator
+        contexts raise — HBM is PJRT-owned; create an NDArray on the
+        device instead (the reference's GPU path has no TPU analog by
+        design)."""
+        ctx = ctx if ctx is not None else current_context()
+        if not isinstance(ctx, Context):
+            raise MXNetError("ctx must be a Context, got %r" % (ctx,))
+        if ctx.device_type not in ("cpu", "cpu_pinned", "cpu_shared"):
+            raise MXNetError(
+                "Storage.alloc on %s: device memory is managed by PJRT/XLA; "
+                "allocate via mx.nd.* with ctx=%s" % (ctx, ctx))
+        if size < 0:
+            raise MXNetError("negative allocation size %d" % size)
+        block = _pool.alloc(size)
+        return Handle(block[:size], size, ctx, block)
+
+    @staticmethod
+    def free(handle):
+        """Return the block to the pool (reference Storage::Free)."""
+        if handle._freed:
+            return
+        handle._freed = True
+        _pool.free(handle._block)
+        handle.dptr = None
+        handle._block = None
+
+    @staticmethod
+    def direct_free(handle):
+        """Free bypassing the pool (reference Storage::DirectFree)."""
+        if handle._freed:
+            return
+        handle._freed = True
+        handle.dptr = None
+        handle._block = None
+
+    @staticmethod
+    def release_all(ctx=None):
+        """Drop all cached pool blocks (reference ReleaseAll /
+        ``Context.empty_cache``)."""
+        _pool.release_all()
+
+    @staticmethod
+    def pool_stats():
+        """Allocator counters (reference storage profiler analog)."""
+        return _pool.stats()
+
+
+def device_memory_info(ctx=None):
+    """(free_bytes, total_bytes) for the context's device.
+
+    Reference: ``mx.context.gpu_memory_info`` → ``cudaMemGetInfo``. On TPU
+    this reads PJRT ``memory_stats`` (bytes_in_use / bytes_limit); host
+    contexts report (0, 0) like the reference does for CPU."""
+    ctx = ctx if ctx is not None else current_context()
+    if ctx.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+        return (0, 0)
+    dev = ctx.jax_device()
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return (0, 0)
+    total = stats.get("bytes_limit", 0)
+    in_use = stats.get("bytes_in_use", 0)
+    return (max(total - in_use, 0), total)
+
+
+# module-level conveniences matching the reference's C API verbs
+alloc = Storage.alloc
+free = Storage.free
+direct_free = Storage.direct_free
+release_all = Storage.release_all
+pool_stats = Storage.pool_stats

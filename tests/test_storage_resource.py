@@ -1,0 +1,192 @@
+"""Storage manager + resource manager + predict API tests.
+
+Mirrors the reference's tests/cpp/storage/storage_test.cc (alloc/free/pool
+reuse), the resource attachment semantics of src/resource.cc, and the
+predict-API usage pattern of example/image-classification/predict-cpp.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resource, storage
+
+
+class TestStorage:
+    def test_alloc_free_roundtrip(self):
+        h = storage.alloc(1000, mx.cpu())
+        assert h.size == 1000
+        assert h.dptr.nbytes == 1000
+        h.dptr[:] = 7
+        storage.free(h)
+        assert h.dptr is None
+
+    def test_pool_reuse(self):
+        storage.release_all()
+        before = storage.pool_stats()
+        h1 = storage.alloc(5000, mx.cpu())
+        storage.free(h1)
+        h2 = storage.alloc(5000, mx.cpu())  # same size class -> pool hit
+        after = storage.pool_stats()
+        assert after["pool_hits"] == before["pool_hits"] + 1
+        storage.free(h2)
+
+    def test_size_classes_round_pow2(self):
+        h = storage.alloc(5000, mx.cpu())
+        assert h._block.nbytes == 8192
+        storage.free(h)
+        tiny = storage.alloc(3, mx.cpu())
+        assert tiny._block.nbytes == 4096  # 4KB floor
+        storage.free(tiny)
+
+    def test_release_all_empties_pool(self):
+        h = storage.alloc(4096, mx.cpu())
+        storage.free(h)
+        storage.release_all()
+        assert storage.pool_stats()["cached_blocks"] == 0
+
+    def test_double_free_is_noop(self):
+        h = storage.alloc(64, mx.cpu())
+        storage.free(h)
+        storage.free(h)  # no raise
+
+    def test_direct_free_bypasses_pool(self):
+        storage.release_all()
+        h = storage.alloc(4096, mx.cpu())
+        storage.direct_free(h)
+        assert storage.pool_stats()["cached_blocks"] == 0
+
+    def test_device_alloc_rejected(self):
+        with pytest.raises(mx.MXNetError):
+            storage.alloc(10, mx.tpu(0))
+
+    def test_device_memory_info_host_is_zero(self):
+        assert storage.device_memory_info(mx.cpu()) == (0, 0)
+
+
+class TestResource:
+    def test_temp_space_grows_and_reuses(self):
+        r = resource.request(resource.ResourceRequest.kTempSpace, mx.cpu())
+        a = r.get_space((4, 5))
+        assert a.shape == (4, 5) and a.dtype == np.float32
+        b = r.get_space((2, 2))     # smaller: same backing block
+        assert b.shape == (2, 2)
+        c = r.get_space((100, 100))  # bigger: regrow
+        assert c.shape == (100, 100)
+
+    def test_rng_streams_independent(self):
+        r1 = resource.request(resource.ResourceRequest.kRandom, mx.cpu())
+        r2 = resource.request(resource.ResourceRequest.kRandom, mx.cpu())
+        k1, k2 = r1.next_key(), r2.next_key()
+        # distinct resources (round-robin pool of 2) give distinct keys
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+    def test_parallel_random_vector(self):
+        r = resource.request(resource.ResourceRequest.kParallelRandom,
+                             mx.cpu())
+        ks = r.parallel_keys(4)
+        assert len(ks) == 4
+
+    def test_type_mismatch_raises(self):
+        r = resource.request(resource.ResourceRequest.kTempSpace, mx.cpu())
+        with pytest.raises(mx.MXNetError):
+            r.next_key()
+        r2 = resource.request(resource.ResourceRequest.kRandom, mx.cpu())
+        with pytest.raises(mx.MXNetError):
+            r2.get_space((2,))
+
+    def test_seed_makes_stream_reproducible(self):
+        r = resource.request(resource.ResourceRequest.kRandom, mx.cpu())
+        r.seed(42)
+        a = np.asarray(r.next_key())
+        r.seed(42)
+        b = np.asarray(r.next_key())
+        assert np.array_equal(a, b)
+
+
+class TestPredictor:
+    def _mlp(self):
+        data = mx.sym.var("data")
+        w1 = mx.sym.var("fc1_weight")
+        b1 = mx.sym.var("fc1_bias")
+        h = mx.sym.FullyConnected(data, weight=w1, bias=b1, num_hidden=8,
+                                  name="fc1")
+        act = mx.sym.Activation(h, act_type="relu", name="relu1")
+        out = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+        return mx.sym.SoftmaxOutput(out, name="softmax")
+
+    def _params_bytes(self, sym, tmp_path):
+        rng = np.random.RandomState(0)
+        shapes, _, _ = sym.infer_shape(data=(2, 10))
+        args = sym.list_arguments()
+        params = {}
+        for name, shp in zip(args, shapes):
+            if name in ("data", "softmax_label"):
+                continue
+            params["arg:" + name] = mx.nd.array(
+                rng.uniform(-1, 1, shp).astype(np.float32))
+        f = str(tmp_path / "m.params")
+        mx.nd.save(f, params)
+        return open(f, "rb").read(), params
+
+    def test_create_forward_get_output(self, tmp_path):
+        sym = self._mlp()
+        blob, params = self._params_bytes(sym, tmp_path)
+        pred = mx.Predictor(sym.tojson(), blob, mx.cpu(),
+                            input_shapes={"data": (2, 10)})
+        x = np.random.RandomState(1).rand(2, 10).astype(np.float32)
+        pred.forward(data=x)
+        out = pred.get_output(0)
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+    def test_matches_executor(self, tmp_path):
+        sym = self._mlp()
+        blob, params = self._params_bytes(sym, tmp_path)
+        pred = mx.Predictor(sym.tojson(), blob, mx.cpu(),
+                            input_shapes={"data": (4, 10)})
+        x = np.random.RandomState(2).rand(4, 10).astype(np.float32)
+        pred.forward(data=x)
+        got = pred.get_output(0)
+
+        ex = sym.simple_bind(mx.cpu(), grad_req="null", data=(4, 10))
+        for k, v in params.items():
+            ex.arg_dict[k.split(":", 1)[1]][:] = v
+        ex.arg_dict["data"][:] = x
+        want = ex.forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_reshape_shares_params(self, tmp_path):
+        sym = self._mlp()
+        blob, _ = self._params_bytes(sym, tmp_path)
+        pred = mx.Predictor(sym.tojson(), blob, mx.cpu(),
+                            input_shapes={"data": (2, 10)})
+        pred.reshape({"data": (6, 10)})
+        x = np.zeros((6, 10), np.float32)
+        pred.forward(data=x)
+        assert pred.get_output(0).shape == (6, 3)
+
+    def test_partial_out(self, tmp_path):
+        sym = self._mlp()
+        blob, _ = self._params_bytes(sym, tmp_path)
+        pred = mx.Predictor(sym.tojson(), blob, mx.cpu(),
+                            input_shapes={"data": (2, 10)},
+                            output_names=["relu1"])
+        pred.forward(data=np.ones((2, 10), np.float32))
+        assert pred.get_output(0).shape == (2, 8)
+
+    def test_bad_input_name_and_shape(self, tmp_path):
+        sym = self._mlp()
+        blob, _ = self._params_bytes(sym, tmp_path)
+        pred = mx.Predictor(sym.tojson(), blob, mx.cpu(),
+                            input_shapes={"data": (2, 10)})
+        with pytest.raises(mx.MXNetError):
+            pred.set_input("nope", np.zeros((2, 10), np.float32))
+        with pytest.raises(mx.MXNetError):
+            pred.set_input("data", np.zeros((3, 10), np.float32))
+
+    def test_load_frombuffer_roundtrip(self, tmp_path):
+        a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        f = str(tmp_path / "x.params")
+        mx.nd.save(f, {"w": a})
+        loaded = mx.nd.load_frombuffer(open(f, "rb").read())
+        np.testing.assert_array_equal(loaded["w"].asnumpy(), a.asnumpy())
