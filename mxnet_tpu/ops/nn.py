@@ -19,6 +19,8 @@ TPU-first design notes:
 """
 from __future__ import annotations
 
+import functools as _functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -352,106 +354,150 @@ def _dropout(params, data):
 # ---------------------------------------------------------------------------
 # Output heads: ops that define their own gradient (loss layers)
 # ---------------------------------------------------------------------------
+def _attr_num(params, key, default):
+    """Attr as float: symbol JSON carries every attr as a string
+    (reference dmlc::Parameter parses on the C++ side; this is our parse
+    point)."""
+    v = params.get(key, default)
+    if isinstance(v, bool):
+        return float(v)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def _attr_bool(params, key, default=False):
+    v = params.get(key, default)
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes")
+    return bool(v)
+
+
 def _normalize_grad(grad, label, params, per_example_dim):
-    scale = params.get("grad_scale", 1.0)
+    scale = _attr_num(params, "grad_scale", 1.0)
     norm = params.get("normalization", "null")
     if norm == "batch":
         scale = scale / label.shape[0]
     elif norm == "valid":
-        ignore = params.get("ignore_label", -1)
+        ignore = _attr_num(params, "ignore_label", -1)
         valid = jnp.maximum(jnp.sum(label != ignore), 1).astype(grad.dtype)
         scale = scale / valid
     return grad * scale
 
 
-@jax.custom_vjp
-def _softmax_output_fwd(data, label, params_tuple):
-    return jax.nn.softmax(data, axis=-1)
+def _params_key(params):
+    """Hashable, order-independent view of the user attrs (drops internal
+    keys and non-static values) for the per-attr-set head cache."""
+    return tuple(sorted((k, v) for k, v in params.items()
+                        if not k.startswith("_")
+                        and isinstance(v, (int, float, bool, str))))
 
 
-def _so_fwd(data, label, params_tuple):
-    out = jax.nn.softmax(data, axis=-1)
-    return out, (out, label, params_tuple)
+# The head functions close over their (static) attrs instead of taking the
+# attr tuple as a traced argument — strings are not JAX types, and every
+# attr is a string when the symbol came from JSON. One cached custom_vjp
+# per distinct attr set keeps jit caches small.
+@_functools.lru_cache(maxsize=None)
+def _softmax_output_head(ptuple):
+    params = dict(ptuple)
+
+    @jax.custom_vjp
+    def _fwd(data, label):
+        return jax.nn.softmax(data, axis=-1)
+
+    def _so_fwd(data, label):
+        out = jax.nn.softmax(data, axis=-1)
+        return out, (out, label)
+
+    def _so_bwd(res, g):
+        out, label = res
+        return _so_grad(out, label, params)
+
+    _fwd.defvjp(_so_fwd, _so_bwd)
+    return _fwd
 
 
-def _so_bwd(res, g):
-    out, label, params_tuple = res
-    params = dict(params_tuple)
+def _so_grad(out, label, params):
     n_class = out.shape[-1]
     oh = jax.nn.one_hot(label.astype(jnp.int32), n_class, dtype=out.dtype)
     grad = out - oh
-    if params.get("use_ignore", False):
-        ignore = params.get("ignore_label", -1)
+    if _attr_bool(params, "use_ignore"):
+        ignore = _attr_num(params, "ignore_label", -1)
         mask = (label != ignore).astype(out.dtype)
         grad = grad * mask[..., None]
     grad = _normalize_grad(grad, label, params, None)
-    return grad, None, None
-
-
-_softmax_output_fwd.defvjp(_so_fwd, _so_bwd)
+    return grad, None
 
 
 @register("SoftmaxOutput", aliases=("Softmax",))
 def _softmax_output(params, data, label):
     """Reference softmax_output-inl.h: forward softmax, backward (p - y)."""
-    multi = params.get("multi_output", False)
-    ptuple = tuple(sorted((k, v) for k, v in params.items()
-                          if isinstance(v, (int, float, bool, str))))
-    if multi:
+    head = _softmax_output_head(_params_key(params))
+    if _attr_bool(params, "multi_output"):
         # data (N, C, d...) label (N, d...): softmax over axis 1
         perm = (0,) + tuple(range(2, data.ndim)) + (1,)
         inv = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
-        out = _softmax_output_fwd(jnp.transpose(data, perm), label, ptuple)
+        out = head(jnp.transpose(data, perm), label)
         return (jnp.transpose(out, inv),)
     if data.ndim > 2:
-        out = _softmax_output_fwd(data.reshape(-1, data.shape[-1]),
-                                  label.reshape(-1), ptuple)
+        out = head(data.reshape(-1, data.shape[-1]), label.reshape(-1))
         return (out.reshape(data.shape),)
-    return (_softmax_output_fwd(data, label, ptuple),)
+    return (head(data, label),)
 
 
 def _make_output_head(name, fwd_fn, grad_fn):
-    @jax.custom_vjp
-    def _f(data, label, ptuple):
-        return fwd_fn(data)
-
-    def _f_fwd(data, label, ptuple):
-        out = fwd_fn(data)
-        return out, (out, label, ptuple)
-
-    def _f_bwd(res, g):
-        out, label, ptuple = res
+    @_functools.lru_cache(maxsize=None)
+    def head(ptuple):
         params = dict(ptuple)
-        grad = grad_fn(out, label)
-        grad = _normalize_grad(grad, label, params, None)
-        return grad, None, None
 
-    _f.defvjp(_f_fwd, _f_bwd)
+        @jax.custom_vjp
+        def _f(data, label):
+            return fwd_fn(data)
+
+        def _f_fwd(data, label):
+            out = fwd_fn(data)
+            return out, (out, label)
+
+        def _f_bwd(res, g):
+            out, label = res
+            grad = grad_fn(out, label, params)
+            grad = _normalize_grad(grad, label, params, None)
+            return grad, None
+
+        _f.defvjp(_f_fwd, _f_bwd)
+        return _f
 
     @register(name)
     def _op(params, data, label):
-        ptuple = tuple(sorted((k, v) for k, v in params.items()
-                              if isinstance(v, (int, float, bool, str))))
-        return (_f(data, label, ptuple),)
+        return (head(_params_key(params))(data, label),)
     return _op
 
 
 _make_output_head("LinearRegressionOutput", lambda x: x,
-                  lambda o, l: (o - l) / 1.0)
+                  lambda o, l, p: (o - l) / 1.0)
 _make_output_head("LogisticRegressionOutput", jax.nn.sigmoid,
-                  lambda o, l: (o - l))
+                  lambda o, l, p: (o - l))
 _make_output_head("MAERegressionOutput", lambda x: x,
-                  lambda o, l: jnp.sign(o - l))
+                  lambda o, l, p: jnp.sign(o - l))
 _make_output_head("SVMOutput", lambda x: x,
-                  lambda o, l: _svm_grad(o, l))
+                  lambda o, l, p: _svm_grad(o, l, p))
 
 
-def _svm_grad(out, label, margin=1.0):
+def _svm_grad(out, label, params):
+    """Reference svm_output-inl.h: hinge loss gradient with margin,
+    regularization_coefficient (the C multiplier) and use_linear
+    (L1-SVM: -C*y*1{margin - y*f > 0}; L2-SVM: -2C*y*max(0, margin-y*f))."""
+    margin = _attr_num(params, "margin", 1.0)
+    coef = _attr_num(params, "regularization_coefficient", 1.0)
+    linear = _attr_bool(params, "use_linear", False)
     n_class = out.shape[-1]
     oh = jax.nn.one_hot(label.astype(jnp.int32), n_class, dtype=out.dtype)
-    # L1-SVM gradient
-    viol = ((margin - out * (2 * oh - 1)) > 0).astype(out.dtype)
-    return -viol * (2 * oh - 1)
+    sign = 2 * oh - 1
+    viol = jnp.maximum(margin - out * sign, 0.0)
+    if linear:
+        return -coef * sign * (viol > 0).astype(out.dtype)
+    return -2.0 * coef * sign * viol
 
 
 @register("softmax_cross_entropy")
