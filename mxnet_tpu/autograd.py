@@ -105,11 +105,13 @@ class Node:
         self.seq = _STATE.node_count
 
 
-def _zero_cotangent(shape, dtype):
+def _zero_cotangent(shape, dtype, device=None):
     dtype = np.dtype(dtype)
     if np.issubdtype(dtype, np.inexact):
         import jax.numpy as jnp
-        return jnp.zeros(shape, dtype)
+        # place on the tape's device: a default-device zeros would drag the
+        # whole vjp through a cross-device transfer on remote-TPU platforms
+        return jnp.zeros(shape, dtype, device=device)
     # integer/bool outputs carry float0 cotangents in JAX
     return np.zeros(shape, jax.dtypes.float0)
 
@@ -164,37 +166,64 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             leaves[key] = arr
             leaf_cots[key] = cot if key not in leaf_cots else leaf_cots[key] + cot
 
-    any_tape = False
-    for h, hg in zip(heads, head_grads):
-        if h._autograd_node is None and not h._requires_grad:
-            continue
-        any_tape = True
-        if h._autograd_node is not None:
-            visit(h._autograd_node[0])
-        cot = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
-        add_cot(h, cot)
-    if not any_tape:
-        raise MXNetError(
-            "this array is not attached to any computation graph; "
-            "run operations inside autograd.record() first")
+    # Pin JAX's default device to the tape's device for the whole replay:
+    # eager transpose rules and head/zero cotangents materialize constants
+    # (lax.full etc.) on the DEFAULT device, and on a remote-TPU platform
+    # every such constant for a cpu-context tape would be a tunnel round
+    # trip.
+    from .base import device_of
+    tape_dev = None
+    for h in heads:
+        tape_dev = device_of(h._data)
+        if tape_dev is not None:
+            break
 
-    for seq in sorted(nodes, reverse=True):
-        node = nodes[seq]
-        cots = node_cots.get(seq)
-        if cots is None:
-            continue
-        full = [c if c is not None else _zero_cotangent(s, d)
-                for c, (s, d) in zip(cots, zip(node.out_shapes, node.out_dtypes))]
-        if node.vjp_fn is None:
-            raise MXNetError(
-                "computation graph was already freed by a previous backward; "
-                "pass retain_graph=True to backward() to keep it")
-        in_cots = node.vjp_fn(tuple(full))
-        for x, c in zip(node.inputs, in_cots):
-            if c is None or (hasattr(c, "dtype") and c.dtype == jax.dtypes.float0):
+    import contextlib
+    dev_scope = jax.default_device(tape_dev) if tape_dev is not None \
+        else contextlib.nullcontext()
+    with dev_scope:
+        any_tape = False
+        for h, hg in zip(heads, head_grads):
+            if h._autograd_node is None and not h._requires_grad:
                 continue
-            add_cot(x, c)
-        node_cots.pop(seq, None)
+            any_tape = True
+            if h._autograd_node is not None:
+                visit(h._autograd_node[0])
+            if hg is None:
+                cot = jnp.ones(h.shape, h.dtype, device=device_of(h._data))
+            else:
+                cot = hg._data
+            add_cot(h, cot)
+        if not any_tape:
+            raise MXNetError(
+                "this array is not attached to any computation graph; "
+                "run operations inside autograd.record() first")
+
+        for seq in sorted(nodes, reverse=True):
+            node = nodes[seq]
+            cots = node_cots.get(seq)
+            if cots is None:
+                continue
+            dev = None
+            for x in node.inputs:
+                dev = device_of(getattr(x, "_data", None))
+                if dev is not None:
+                    break
+            full = [c if c is not None else _zero_cotangent(s, d, dev)
+                    for c, (s, d) in
+                    zip(cots, zip(node.out_shapes, node.out_dtypes))]
+            if node.vjp_fn is None:
+                raise MXNetError(
+                    "computation graph was already freed by a previous "
+                    "backward; pass retain_graph=True to backward() to "
+                    "keep it")
+            in_cots = node.vjp_fn(tuple(full))
+            for x, c in zip(node.inputs, in_cots):
+                if c is None or (hasattr(c, "dtype")
+                                 and c.dtype == jax.dtypes.float0):
+                    continue
+                add_cot(x, c)
+            node_cots.pop(seq, None)
 
     # write into .grad respecting grad_req
     for key, arr in leaves.items():

@@ -12,7 +12,20 @@ import threading
 
 import numpy as np
 
-__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types"]
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "device_of"]
+
+
+def device_of(val):
+    """First device holding `val` (a jax.Array), or None when it has no
+    device (tracer, numpy). The shared helper behind every "keep this
+    constant on the data's device" placement decision."""
+    if hasattr(val, "devices"):
+        try:
+            return next(iter(val.devices()))
+        except Exception:
+            return None
+    return None
 
 # Version mirrors the reference framework version it provides parity with
 # (reference `include/mxnet/base.h:103-107` => 1.2.1) plus our own epoch.

@@ -361,6 +361,13 @@ class HybridBlock(Block):
 
     def hybridize(self, active=True, **kwargs):
         self._active = active
+        if "remat" not in kwargs:
+            # reference env MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md
+            # there): recompute activations in backward; here it defaults
+            # hybridize(remat=...) to jax.checkpoint
+            import os
+            if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1":
+                kwargs["remat"] = True
         self._flags = kwargs
         self._clear_cached_op()
         super().hybridize(active, **kwargs)
@@ -432,7 +439,9 @@ class HybridBlock(Block):
         param_nds = [params[n].data() for n in names]
         param_vals = [p._data for p in param_nds]
         input_vals = [a._data if isinstance(a, NDArray) else a for a in args]
-        key = _random.next_key()
+        key_anchor = param_vals[0] if param_vals else (
+            input_vals[0] if input_vals else None)
+        key = _random.next_key_like(key_anchor)
         is_train = autograd.is_training()
 
         if autograd.is_recording():

@@ -20,7 +20,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..base import MXNetError, dtype_np, numeric_types, integer_types
+from ..base import MXNetError, dtype_np, numeric_types, integer_types, \
+    device_of
 from ..context import Context, current_context, cpu
 from ..ops.invoke import invoke
 
@@ -162,7 +163,9 @@ class NDArray:
         """Reference gluon Parameter/autograd leaf marking."""
         self._requires_grad = True
         self._grad_req = grad_req
-        self.grad = NDArray(jnp.zeros(self.shape, self.dtype), self._ctx)
+        self.grad = NDArray(jnp.zeros(self.shape, self.dtype,
+                                      device=device_of(self._data)),
+                            self._ctx)
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
         from .. import autograd
@@ -184,9 +187,15 @@ class NDArray:
         if isinstance(value, NDArray):
             value = value._data
         elif isinstance(value, (np.ndarray, list, tuple, *numeric_types)):
-            value = jnp.asarray(value, dtype=self.dtype)
+            # keep host constants in numpy: they are weakly committed, so
+            # the .at[].set below runs on self's device instead of pulling
+            # everything through the default device
+            value = np.asarray(value, dtype=self.dtype)
         if key == slice(None) and getattr(value, "shape", None) == self.shape:
-            self._data = jnp.asarray(value, self.dtype)
+            if isinstance(value, np.ndarray):
+                self._data = jax.device_put(value, device_of(self._data))
+            else:
+                self._data = jnp.asarray(value, self.dtype)
         else:
             self._data = self._data.at[key].set(value.astype(self.dtype)
                                                 if hasattr(value, "astype") else value)
@@ -513,7 +522,10 @@ def array(source_array, ctx=None, dtype=None):
     if npa.dtype == np.int64 and dtype is None and not isinstance(source_array, np.ndarray):
         npa = npa.astype(np.int32) if npa.size and np.abs(npa).max() < 2**31 else npa
     ctx, dev = _dev(ctx)
-    return NDArray(jax.device_put(jnp.asarray(npa), dev), ctx)
+    # single host->dev put; routing through jnp.asarray first would
+    # materialize on the DEFAULT device (under a remote-TPU platform that
+    # is a tunnel round trip per call) before transferring
+    return NDArray(jax.device_put(npa, dev), ctx)
 
 
 def empty(shape, ctx=None, dtype=None):
@@ -523,27 +535,27 @@ def empty(shape, ctx=None, dtype=None):
 def zeros(shape, ctx=None, dtype=None, **kwargs):
     ctx, dev = _dev(ctx)
     shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
-    return NDArray(jax.device_put(jnp.zeros(shape, dtype_np(dtype)), dev), ctx)
+    return NDArray(jnp.zeros(shape, dtype_np(dtype), device=dev), ctx)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs):
     ctx, dev = _dev(ctx)
     shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
-    return NDArray(jax.device_put(jnp.ones(shape, dtype_np(dtype)), dev), ctx)
+    return NDArray(jnp.ones(shape, dtype_np(dtype), device=dev), ctx)
 
 
 def full(shape, val, ctx=None, dtype=None):
     ctx, dev = _dev(ctx)
     shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
-    return NDArray(jax.device_put(jnp.full(shape, val, dtype_np(dtype)), dev), ctx)
+    return NDArray(jnp.full(shape, val, dtype_np(dtype), device=dev), ctx)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
     ctx, dev = _dev(ctx)
-    out = jnp.arange(start, stop, step, dtype_np(dtype))
+    out = jnp.arange(start, stop, step, dtype_np(dtype), device=dev)
     if repeat > 1:
         out = jnp.repeat(out, repeat)
-    return NDArray(jax.device_put(out, dev), ctx)
+    return NDArray(out, ctx)
 
 
 def concatenate(arrays, axis=0, always_copy=True):

@@ -24,9 +24,12 @@ def _unary(name, fn, aliases=()):
 
 
 def _promote_scalar(x, s):
-    # reference scalar ops keep the array dtype
-    return jnp.asarray(s, dtype=x.dtype if jnp.issubdtype(x.dtype, jnp.inexact)
-                       or float(s) == int(s) else jnp.float32).astype(x.dtype)
+    # reference scalar ops keep the array dtype. Build the constant as a
+    # host numpy scalar: it is weakly committed, so the op runs on x's
+    # device. jnp.asarray here would materialize it on the DEFAULT device —
+    # under a remote-TPU platform that turns every cpu-context scalar op
+    # into a ~100ms cross-device transfer.
+    return np.asarray(s).astype(x.dtype)
 
 
 def _binary_b(name, fn, aliases=()):

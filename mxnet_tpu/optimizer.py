@@ -603,9 +603,13 @@ class FusedApplier:
                     / (1.0 - opt.beta1 ** t)
             lrs.append(lr)
             wds.append(opt._get_wd(i))
-        lrs = jnp.asarray(_np.asarray(lrs, _np.float32))
-        wds = jnp.asarray(_np.asarray(wds, _np.float32))
-        rescale = jnp.float32(opt.rescale_grad)
+        # keep the hyperparameter vectors in host numpy: they are weakly
+        # committed, so the jitted update runs on the params' device; a
+        # jnp.asarray would commit them to the default device and pull the
+        # whole fused update across devices on remote-TPU platforms
+        lrs = _np.asarray(lrs, _np.float32)
+        wds = _np.asarray(wds, _np.float32)
+        rescale = _np.float32(opt.rescale_grad)
 
         op_name = self._op_name()
         op = self._get_op(op_name)

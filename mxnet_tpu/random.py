@@ -2,10 +2,16 @@
 
 Parity with reference `src/common/random_generator.h` + `python/mxnet/random.py`.
 TPU-native: a counter-based threefry key (JAX PRNG) replaces the per-device
-mshadow RNG; `seed()` resets the root key. Sampling ops split a fresh subkey
+mshadow RNG; `seed()` resets every stream. Sampling ops split a fresh subkey
 per call, so eager sampling is stateful at the API while each op stays pure
 (SURVEY.md §7 hard-part 7: bitwise parity with the reference RNG is
 deliberately not attempted; tests are statistical).
+
+Like the reference (one sampler per device, random_generator.h), the key
+chain is **per jax.Device**: splits execute on the device that will consume
+the bits. A single global key would live on the default device and drag
+every op on another device through a cross-device copy — on a remote-TPU
+platform that is a tunnel round trip per sample.
 """
 from __future__ import annotations
 
@@ -22,36 +28,89 @@ class _RandState(threading.local):
     # (multi-process workers must import the package first)
     def __init__(self):
         super().__init__()
-        self.key = None
+        self.seed_val = 0
+        self.dev_seeds = {}     # jax.Device -> pending per-device seed
+        self.keys = {}          # jax.Device -> current chain key
         self.override = None
 
-    def ensure(self):
-        if self.key is None:
-            self.key = jax.random.PRNGKey(0)
+    def key_for(self, dev):
+        key = self.keys.get(dev)
+        if key is None:
+            key = jax.random.PRNGKey(self.dev_seeds.get(dev, self.seed_val))
+            if dev is not None:
+                key = jax.device_put(key, dev)
+                # decorrelate streams across devices (reference seeds each
+                # device sampler with seed ^ devid, random_generator.h)
+                key = jax.random.fold_in(key, dev.id)
+            self.keys[dev] = key
+        return key
 
 
 _STATE = _RandState()
 
 
+def _resolve_device(ctx):
+    """ctx may be a Context, a jax.Device, or None (current context)."""
+    if ctx is None or ctx == "all":
+        from .context import current_context
+        ctx = current_context()
+    if hasattr(ctx, "jax_device"):
+        try:
+            return ctx.jax_device()
+        except Exception:
+            return None
+    return ctx
+
+
 def seed(seed_state, ctx="all"):
-    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    """Reset the key chains (reference mx.random.seed: reseeds every
+    device's sampler when ctx='all', one device otherwise). Also reseeds
+    granted RNG resources (reference ResourceManager::SeedRandom,
+    src/resource.cc)."""
+    seed_state = int(seed_state)
+    if ctx == "all":
+        _STATE.seed_val = seed_state
+        _STATE.dev_seeds.clear()
+        _STATE.keys.clear()
+    else:
+        # scope the reseed to one device: lazily-initialized devices keep
+        # deriving from the previous global seed
+        dev = _resolve_device(ctx)
+        _STATE.dev_seeds[dev] = seed_state
+        _STATE.keys.pop(dev, None)
+    from . import resource as _resource
+    _resource._manager.seed_all(seed_state, ctx)
 
 
-def next_key(ctx=None):
-    """Fresh subkey. Inside a traced scope (see key_scope) the key chain
-    derives from the scope's (possibly tracer) key so compiled programs get a
-    per-call key argument instead of a baked constant."""
-    if _STATE.override is not None:
-        _STATE.override, sub = jax.random.split(_STATE.override)
-        return sub
-    _STATE.ensure()
-    _STATE.key, sub = jax.random.split(_STATE.key)
+def _split_chain(dev):
+    """Advance dev's key chain, returning a fresh subkey."""
+    key = _STATE.key_for(dev)
+    _STATE.keys[dev], sub = jax.random.split(key)
     return sub
 
 
-def get_key():
-    _STATE.ensure()
-    return _STATE.key
+def next_key(ctx=None):
+    """Fresh subkey on ctx's device. Inside a traced scope (see key_scope)
+    the key chain derives from the scope's (possibly tracer) key so compiled
+    programs get a per-call key argument instead of a baked constant."""
+    if _STATE.override is not None:
+        _STATE.override, sub = jax.random.split(_STATE.override)
+        return sub
+    return _split_chain(_resolve_device(ctx))
+
+
+def next_key_like(val):
+    """Fresh subkey on the device holding `val` (a jax.Array) — the path
+    compiled callers use so the key is already co-located with the program's
+    arguments."""
+    if _STATE.override is not None:
+        return next_key()
+    from .base import device_of
+    return _split_chain(device_of(val))
+
+
+def get_key(ctx=None):
+    return _STATE.key_for(_resolve_device(ctx))
 
 
 class key_scope:
