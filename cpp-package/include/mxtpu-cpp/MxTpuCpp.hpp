@@ -1,0 +1,241 @@
+/*!
+ * \file MxTpuCpp.hpp
+ * \brief Header-only C++ API over the libmxtpu C ABI.
+ *
+ * The analog of the reference's cpp-package
+ * (cpp-package/include/mxnet-cpp/MxNetCpp.h there): a thin RAII layer over
+ * the C API so C++ applications get exceptions and containers instead of
+ * int return codes and out-params. Scope matches what is native in this
+ * framework — host-side record IO, image codec, the threaded image
+ * pipeline, and COCO masks; device compute is reached from Python
+ * (JAX/XLA), not from C++.
+ *
+ * Link against mxnet_tpu/native/libmxtpu.so (built by src/Makefile).
+ */
+#ifndef MXTPU_CPP_MXTPUCPP_HPP_
+#define MXTPU_CPP_MXTPUCPP_HPP_
+
+#include <cstddef>
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../src/c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+/*! \brief thrown when a C API call returns nonzero */
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &msg) : std::runtime_error(msg) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw Error(MXTGetLastError() ? MXTGetLastError() : "unknown");
+}
+
+inline int Version() {
+  int v = 0;
+  Check(MXTGetVersion(&v));
+  return v;
+}
+
+/*! \brief sequential RecordIO writer (reference mxnet.recordio.MXRecordIO
+ *  write mode). */
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string &uri) {
+    Check(MXTRecordIOWriterCreate(uri.c_str(), &handle_));
+  }
+  ~RecordIOWriter() {
+    if (handle_) MXTRecordIOWriterFree(handle_);
+  }
+  RecordIOWriter(const RecordIOWriter &) = delete;
+  RecordIOWriter &operator=(const RecordIOWriter &) = delete;
+
+  /*! \brief byte offset the next record will start at (for .idx files) */
+  size_t Tell() {
+    size_t pos = 0;
+    Check(MXTRecordIOWriterTell(handle_, &pos));
+    return pos;
+  }
+  void Write(const void *buf, size_t size) {
+    Check(MXTRecordIOWriterWriteRecord(
+        handle_, static_cast<const char *>(buf), size));
+  }
+  void Write(const std::string &rec) { Write(rec.data(), rec.size()); }
+
+ private:
+  RecordIOHandle handle_ = nullptr;
+};
+
+/*! \brief sequential / seekable RecordIO reader. */
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string &uri) {
+    Check(MXTRecordIOReaderCreate(uri.c_str(), &handle_));
+  }
+  ~RecordIOReader() {
+    if (handle_) MXTRecordIOReaderFree(handle_);
+  }
+  RecordIOReader(const RecordIOReader &) = delete;
+  RecordIOReader &operator=(const RecordIOReader &) = delete;
+
+  /*! \brief read the next record into `out`; false at EOF */
+  bool Next(std::string *out) {
+    const char *buf = nullptr;
+    size_t size = 0;
+    Check(MXTRecordIOReaderReadRecord(handle_, &buf, &size));
+    if (buf == nullptr) return false;
+    out->assign(buf, size);
+    return true;
+  }
+  void Seek(size_t pos) { Check(MXTRecordIOReaderSeek(handle_, pos)); }
+  size_t Tell() {
+    size_t pos = 0;
+    Check(MXTRecordIOReaderTell(handle_, &pos));
+    return pos;
+  }
+
+ private:
+  RecordIOHandle handle_ = nullptr;
+};
+
+/*! \brief decoded HWC uint8 image */
+struct Image {
+  int h = 0, w = 0, c = 0;
+  std::vector<unsigned char> data;
+};
+
+/*! \brief JPEG/PNG decode (flag: 1 RGB, 0 gray, -1 keep source channels) */
+inline Image ImDecode(const void *buf, size_t size, int flag = 1) {
+  Image img;
+  const char *p = static_cast<const char *>(buf);
+  Check(MXTImageDecode(p, size, flag, &img.h, &img.w, &img.c, nullptr));
+  img.data.resize(static_cast<size_t>(img.h) * img.w * img.c);
+  Check(MXTImageDecode(p, size, flag, &img.h, &img.w, &img.c,
+                       img.data.data()));
+  return img;
+}
+
+inline std::string ImEncodeJPEG(const Image &img, int quality = 95) {
+  size_t cap = 0;
+  Check(MXTImageEncodeJPEG(img.data.data(), img.h, img.w, img.c, quality,
+                           nullptr, &cap));
+  std::string out(cap, '\0');
+  size_t size = cap;
+  Check(MXTImageEncodeJPEG(img.data.data(), img.h, img.w, img.c, quality,
+                           &out[0], &size));
+  out.resize(size);
+  return out;
+}
+
+inline Image ImResize(const Image &src, int dh, int dw) {
+  Image dst;
+  dst.h = dh;
+  dst.w = dw;
+  dst.c = src.c;
+  dst.data.resize(static_cast<size_t>(dh) * dw * src.c);
+  Check(MXTImageResize(src.data.data(), src.h, src.w, src.c,
+                       dst.data.data(), dh, dw));
+  return dst;
+}
+
+/*! \brief COCO RLE mask (column-major h*w binary <-> counts) */
+class RLE {
+ public:
+  RLE() = default;
+  RLE(std::vector<uint32_t> counts, int h, int w)
+      : counts_(std::move(counts)), h_(h), w_(w) {}
+
+  static RLE Encode(const std::vector<unsigned char> &mask, int h, int w) {
+    size_t len = 0;
+    Check(MXTMaskEncode(mask.data(), h, w, nullptr, &len));
+    std::vector<uint32_t> counts(len);
+    Check(MXTMaskEncode(mask.data(), h, w, counts.data(), &len));
+    return RLE(std::move(counts), h, w);
+  }
+
+  std::vector<unsigned char> Decode() const {
+    std::vector<unsigned char> mask(static_cast<size_t>(h_) * w_);
+    Check(MXTMaskDecode(counts_.data(), counts_.size(), h_, w_,
+                        mask.data()));
+    return mask;
+  }
+
+  uint32_t Area() const {
+    uint32_t area = 0;
+    Check(MXTMaskArea(counts_.data(), counts_.size(), &area));
+    return area;
+  }
+
+  /*! \brief IoU against another mask (iscrowd uses the crowd denominator) */
+  double IoU(const RLE &other, bool iscrowd = false) const {
+    double out = 0;
+    size_t la[1] = {counts_.size()}, lb[1] = {other.counts_.size()};
+    unsigned char crowd[1] = {static_cast<unsigned char>(iscrowd ? 1 : 0)};
+    Check(MXTMaskIoU(counts_.data(), la, 1, other.counts_.data(), lb, 1,
+                     h_, w_, iscrowd ? crowd : nullptr, &out));
+    return out;
+  }
+
+  const std::vector<uint32_t> &counts() const { return counts_; }
+  int height() const { return h_; }
+  int width() const { return w_; }
+
+ private:
+  std::vector<uint32_t> counts_;
+  int h_ = 0, w_ = 0;
+};
+
+/*! \brief threaded decode/augment/batch pipeline over a .rec file
+ *  (reference ImageRecordIter, src/io/iter_image_recordio_2.cc there) */
+class ImagePipeline {
+ public:
+  struct Config {
+    int batch = 32, h = 224, w = 224, c = 3, label_width = 1;
+    int nthreads = 4;
+    bool shuffle = false, rand_crop = false, rand_mirror = false;
+    int resize = 0;
+    uint64_t seed = 0;
+    const float *mean = nullptr;  // per-channel, length c
+    const float *std = nullptr;
+    int part_index = 0, num_parts = 1;
+  };
+
+  ImagePipeline(const std::string &rec_path, const Config &cfg) : cfg_(cfg) {
+    Check(MXTImagePipelineCreate(
+        rec_path.c_str(), cfg.batch, cfg.h, cfg.w, cfg.c, cfg.label_width,
+        cfg.nthreads, cfg.shuffle, cfg.rand_crop, cfg.rand_mirror,
+        cfg.resize, cfg.seed, cfg.mean, cfg.std, cfg.part_index,
+        cfg.num_parts, &handle_));
+  }
+  ~ImagePipeline() {
+    if (handle_) MXTImagePipelineFree(handle_);
+  }
+  ImagePipeline(const ImagePipeline &) = delete;
+  ImagePipeline &operator=(const ImagePipeline &) = delete;
+
+  /*! \brief fill a batch; returns false at epoch end. pad = slots unfilled
+   *  in the final short batch. data: batch*c*h*w floats, label:
+   *  batch*label_width floats. */
+  bool Next(float *data, float *label, int *pad) {
+    int eof = 0;
+    Check(MXTImagePipelineNext(handle_, data, label, pad, &eof));
+    return eof == 0;
+  }
+  void Reset() { Check(MXTImagePipelineReset(handle_)); }
+  const Config &config() const { return cfg_; }
+
+ private:
+  Config cfg_;
+  ImagePipelineHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_MXTPUCPP_HPP_

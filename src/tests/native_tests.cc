@@ -1,0 +1,332 @@
+// Native unit tests for libmxtpu (the tests/cpp analog of the reference:
+// tests/cpp/{engine,storage,operator} run under googletest there;
+// googletest is not in this image so a minimal CHECK harness stands in).
+// Covers: error convention, RecordIO framing (incl. magic-word chunking),
+// image codec, bilinear resize, COCO RLE masks, and the threaded image
+// pipeline end-to-end (reference: src/io/iter_image_recordio_2.cc).
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../c_api.h"
+#include "../../cpp-package/include/mxtpu-cpp/MxTpuCpp.hpp"
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK_MSG(cond, msg)                                              \
+  do {                                                                    \
+    ++g_checks;                                                           \
+    if (!(cond)) {                                                        \
+      ++g_failures;                                                       \
+      std::fprintf(stderr, "FAIL %s:%d: %s (%s)\n", __FILE__, __LINE__,   \
+                   #cond, msg);                                           \
+    }                                                                     \
+  } while (0)
+#define CHECK(cond) CHECK_MSG(cond, "")
+#define CHECK_OK(call) CHECK_MSG((call) == 0, MXTGetLastError())
+
+static std::string TempPath(const char *name) {
+  return std::string("/tmp/mxtpu_native_test_") + name;
+}
+
+// ---------------------------------------------------------------- error
+static void TestErrorConvention() {
+  RecordIOHandle h = nullptr;
+  int rc = MXTRecordIOReaderCreate("/nonexistent/dir/file.rec", &h);
+  CHECK(rc != 0);
+  CHECK(MXTGetLastError() != nullptr);
+  CHECK(std::strlen(MXTGetLastError()) > 0);
+  int ver = 0;
+  CHECK_OK(MXTGetVersion(&ver));
+  CHECK(ver > 0);
+}
+
+// ------------------------------------------------------------- recordio
+static void TestRecordIORoundtrip() {
+  std::string path = TempPath("rt.rec");
+  RecordIOHandle w = nullptr;
+  CHECK_OK(MXTRecordIOWriterCreate(path.c_str(), &w));
+
+  // record 2 embeds the on-disk magic word to exercise the chunk-split
+  // path (recordio_format.h cflag 1/2/3 framing)
+  const uint32_t magic = 0xced7230a;
+  std::string r0 = "hello records";
+  std::string r1(64, 'x');
+  std::string r2 = "asdf";
+  r2.append(reinterpret_cast<const char *>(&magic), 4);
+  r2.append("tail-after-magic");
+  std::vector<std::string> recs = {r0, r1, r2};
+
+  std::vector<size_t> tells;
+  for (const auto &r : recs) {
+    size_t pos = 0;
+    CHECK_OK(MXTRecordIOWriterTell(w, &pos));
+    tells.push_back(pos);
+    CHECK_OK(MXTRecordIOWriterWriteRecord(w, r.data(), r.size()));
+  }
+  CHECK_OK(MXTRecordIOWriterFree(w));
+
+  RecordIOHandle rd = nullptr;
+  CHECK_OK(MXTRecordIOReaderCreate(path.c_str(), &rd));
+  for (const auto &want : recs) {
+    const char *buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(MXTRecordIOReaderReadRecord(rd, &buf, &size));
+    CHECK(buf != nullptr);
+    CHECK_MSG(size == want.size(), "record size mismatch");
+    CHECK(size == want.size() && std::memcmp(buf, want.data(), size) == 0);
+  }
+  const char *buf = nullptr;
+  size_t size = 1;
+  CHECK_OK(MXTRecordIOReaderReadRecord(rd, &buf, &size));
+  CHECK(buf == nullptr && size == 0);  // EOF
+
+  // indexed access: seek back to record 1 (rec2idx/IndexedRecordIO analog)
+  CHECK_OK(MXTRecordIOReaderSeek(rd, tells[1]));
+  CHECK_OK(MXTRecordIOReaderReadRecord(rd, &buf, &size));
+  CHECK(size == recs[1].size());
+  CHECK_OK(MXTRecordIOReaderFree(rd));
+  std::remove(path.c_str());
+}
+
+// ---------------------------------------------------------- image codec
+static std::vector<unsigned char> MakeGradient(int h, int w, int c) {
+  std::vector<unsigned char> img(static_cast<size_t>(h) * w * c);
+  for (int y = 0; y < h; ++y)
+    for (int x = 0; x < w; ++x)
+      for (int ch = 0; ch < c; ++ch)
+        // smooth ramp: JPEG keeps this within a few counts; a wrapping
+        // pattern would put discontinuities in every block and fail any
+        // tight roundtrip bound
+        img[(static_cast<size_t>(y) * w + x) * c + ch] =
+            static_cast<unsigned char>(y * 2 + x + ch * 20);
+  return img;
+}
+
+static void TestImageCodec() {
+  const int h = 37, w = 53, c = 3;
+  std::vector<unsigned char> img = MakeGradient(h, w, c);
+
+  size_t cap = 0;
+  CHECK_OK(MXTImageEncodeJPEG(img.data(), h, w, c, 95, nullptr, &cap));
+  CHECK(cap > 0);
+  std::vector<char> jpg(cap);
+  size_t size = cap;
+  CHECK_OK(MXTImageEncodeJPEG(img.data(), h, w, c, 95, jpg.data(), &size));
+  CHECK(size > 0 && size <= cap);
+
+  int dh = 0, dw = 0, dc = 0;
+  CHECK_OK(MXTImageDecode(jpg.data(), size, 1, &dh, &dw, &dc, nullptr));
+  CHECK(dh == h && dw == w && dc == 3);
+  std::vector<unsigned char> dec(static_cast<size_t>(dh) * dw * dc);
+  CHECK_OK(MXTImageDecode(jpg.data(), size, 1, &dh, &dw, &dc, dec.data()));
+
+  double err = 0;
+  for (size_t i = 0; i < dec.size(); ++i)
+    err += std::abs(static_cast<int>(dec[i]) - static_cast<int>(img[i]));
+  err /= dec.size();
+  CHECK_MSG(err < 6.0, "mean abs JPEG roundtrip error too high");
+
+  // grayscale decode collapses channels
+  CHECK_OK(MXTImageDecode(jpg.data(), size, 0, &dh, &dw, &dc, nullptr));
+  CHECK(dc == 1);
+}
+
+static void TestImageResize() {
+  const int h = 16, w = 24, c = 3;
+  std::vector<unsigned char> img(static_cast<size_t>(h) * w * c, 111);
+  std::vector<unsigned char> dst(8 * 12 * c);
+  CHECK_OK(MXTImageResize(img.data(), h, w, c, dst.data(), 8, 12));
+  for (unsigned char v : dst) CHECK(v == 111);  // uniform stays uniform
+}
+
+// ----------------------------------------------------------- mask api
+static void TestMasks() {
+  const int h = 8, w = 8;
+  // column-major (COCO layout): a 4x4 square in the top-left
+  std::vector<unsigned char> m(h * w, 0);
+  for (int x = 0; x < 4; ++x)
+    for (int y = 0; y < 4; ++y) m[x * h + y] = 1;
+
+  size_t len = 0;
+  CHECK_OK(MXTMaskEncode(m.data(), h, w, nullptr, &len));
+  std::vector<uint32_t> counts(len);
+  CHECK_OK(MXTMaskEncode(m.data(), h, w, counts.data(), &len));
+
+  uint32_t area = 0;
+  CHECK_OK(MXTMaskArea(counts.data(), len, &area));
+  CHECK(area == 16);
+
+  std::vector<unsigned char> dec(h * w, 255);
+  CHECK_OK(MXTMaskDecode(counts.data(), len, h, w, dec.data()));
+  CHECK(std::memcmp(dec.data(), m.data(), m.size()) == 0);
+
+  // IoU of a mask with itself is 1
+  double iou = 0;
+  size_t lens[1] = {len};
+  CHECK_OK(MXTMaskIoU(counts.data(), lens, 1, counts.data(), lens, 1, h, w,
+                      nullptr, &iou));
+  CHECK(std::abs(iou - 1.0) < 1e-9);
+
+  // merge(m, m, intersect) == m ; area preserved
+  std::vector<uint32_t> two(counts);
+  two.insert(two.end(), counts.begin(), counts.end());
+  size_t lens2[2] = {len, len};
+  size_t mlen = 0;
+  CHECK_OK(MXTMaskMerge(two.data(), lens2, 2, h, w, 1, nullptr, &mlen));
+  std::vector<uint32_t> merged(mlen);
+  CHECK_OK(MXTMaskMerge(two.data(), lens2, 2, h, w, 1, merged.data(), &mlen));
+  uint32_t marea = 0;
+  CHECK_OK(MXTMaskArea(merged.data(), mlen, &marea));
+  CHECK(marea == 16);
+
+  // polygon: the same square as xy corners
+  double poly[8] = {0, 0, 4, 0, 4, 4, 0, 4};
+  size_t plen = 0;
+  CHECK_OK(MXTMaskFrPoly(poly, 4, h, w, nullptr, &plen));
+  std::vector<uint32_t> pc(plen);
+  CHECK_OK(MXTMaskFrPoly(poly, 4, h, w, pc.data(), &plen));
+  uint32_t parea = 0;
+  CHECK_OK(MXTMaskArea(pc.data(), plen, &parea));
+  CHECK_MSG(parea >= 9 && parea <= 25, "polygon raster area out of range");
+}
+
+// ------------------------------------------------------ image pipeline
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+static void TestImagePipeline() {
+  const int n = 7, ih = 24, iw = 24, c = 3;
+  std::string path = TempPath("pipe.rec");
+  RecordIOHandle w = nullptr;
+  CHECK_OK(MXTRecordIOWriterCreate(path.c_str(), &w));
+  for (int i = 0; i < n; ++i) {
+    std::vector<unsigned char> img(static_cast<size_t>(ih) * iw * c,
+                                   static_cast<unsigned char>(10 * i + 5));
+    size_t cap = 0;
+    CHECK_OK(MXTImageEncodeJPEG(img.data(), ih, iw, c, 95, nullptr, &cap));
+    std::vector<char> jpg(cap);
+    size_t js = cap;
+    CHECK_OK(MXTImageEncodeJPEG(img.data(), ih, iw, c, 95, jpg.data(), &js));
+    IRHeader header;
+    header.flag = 0;
+    header.label = static_cast<float>(i);
+    header.id = static_cast<uint64_t>(i);
+    header.id2 = 0;
+    std::string rec(reinterpret_cast<const char *>(&header), sizeof(header));
+    rec.append(jpg.data(), js);
+    CHECK_OK(MXTRecordIOWriterWriteRecord(w, rec.data(), rec.size()));
+  }
+  CHECK_OK(MXTRecordIOWriterFree(w));
+
+  const int batch = 3, oh = 16, ow = 16;
+  ImagePipelineHandle p = nullptr;
+  CHECK_OK(MXTImagePipelineCreate(path.c_str(), batch, oh, ow, c,
+                                  /*label_width=*/1, /*nthreads=*/2,
+                                  /*shuffle=*/0, /*rand_crop=*/0,
+                                  /*rand_mirror=*/0, /*resize=*/0,
+                                  /*seed=*/7, nullptr, nullptr, 0, 1, &p));
+  std::vector<float> data(static_cast<size_t>(batch) * c * oh * ow);
+  std::vector<float> label(batch);
+  int seen = 0, batches = 0;
+  for (;;) {
+    int pad = -1, eof = -1;
+    CHECK_OK(MXTImagePipelineNext(p, data.data(), label.data(), &pad, &eof));
+    if (eof) break;
+    ++batches;
+    seen += batch - pad;
+    for (int b = 0; b < batch - pad; ++b) {
+      // every pixel of example b equals its fill value
+      float want = 10.0f * label[b] + 5.0f;
+      float got = data[static_cast<size_t>(b) * c * oh * ow];
+      CHECK_MSG(std::abs(got - want) < 4.0f, "pipeline pixel mismatch");
+    }
+  }
+  CHECK_MSG(seen == n, "pipeline did not yield all examples");
+  CHECK(batches == (n + batch - 1) / batch);
+
+  // second epoch after reset
+  CHECK_OK(MXTImagePipelineReset(p));
+  int pad = -1, eof = -1;
+  CHECK_OK(MXTImagePipelineNext(p, data.data(), label.data(), &pad, &eof));
+  CHECK(!eof && pad == 0);
+  CHECK_OK(MXTImagePipelineFree(p));
+  std::remove(path.c_str());
+}
+
+// -------------------------------------------------- cpp-package wrapper
+static void TestCppPackage() {
+  namespace mc = mxtpu::cpp;
+  CHECK(mc::Version() > 0);
+
+  std::string path = TempPath("cpp.rec");
+  {
+    mc::RecordIOWriter w(path);
+    CHECK(w.Tell() == 0);
+    w.Write("first");
+    w.Write("second record");
+  }
+  {
+    mc::RecordIOReader r(path);
+    std::string rec;
+    CHECK(r.Next(&rec) && rec == "first");
+    CHECK(r.Next(&rec) && rec == "second record");
+    CHECK(!r.Next(&rec));
+  }
+  std::remove(path.c_str());
+
+  // RAII error surface
+  bool threw = false;
+  try {
+    mc::RecordIOReader bad("/nonexistent/x.rec");
+  } catch (const mc::Error &) {
+    threw = true;
+  }
+  CHECK(threw);
+
+  // image codec via the wrapper
+  mc::Image img;
+  img.h = 20;
+  img.w = 30;
+  img.c = 3;
+  img.data.assign(static_cast<size_t>(img.h) * img.w * img.c, 128);
+  std::string jpg = mc::ImEncodeJPEG(img);
+  mc::Image dec = mc::ImDecode(jpg.data(), jpg.size());
+  CHECK(dec.h == 20 && dec.w == 30 && dec.c == 3);
+  mc::Image small = mc::ImResize(dec, 10, 15);
+  CHECK(small.data.size() == 10u * 15u * 3u);
+
+  // masks via the wrapper
+  std::vector<unsigned char> m(64, 0);
+  for (int i = 0; i < 16; ++i) m[i] = 1;
+  mc::RLE rle = mc::RLE::Encode(m, 8, 8);
+  CHECK(rle.Area() == 16);
+  CHECK(rle.Decode() == m);
+  CHECK(std::abs(rle.IoU(rle) - 1.0) < 1e-9);
+}
+
+int main() {
+  TestErrorConvention();
+  TestRecordIORoundtrip();
+  TestImageCodec();
+  TestImageResize();
+  TestMasks();
+  TestImagePipeline();
+  TestCppPackage();
+  if (g_failures) {
+    std::fprintf(stderr, "%d/%d checks FAILED\n", g_failures, g_checks);
+    return 1;
+  }
+  std::printf("all %d native checks passed\n", g_checks);
+  return 0;
+}

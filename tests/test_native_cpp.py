@@ -1,0 +1,22 @@
+"""Build and run the native C++ unit tests (the reference's tests/cpp
+suite analog — tests/cpp/{engine,storage,operator} there run under
+googletest; src/tests/native_tests.cc is a self-contained CHECK harness
+over the libmxtpu C API)."""
+import os
+import subprocess
+
+import pytest
+
+SRC = os.path.join(os.path.dirname(__file__), os.pardir, "src")
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(SRC, "Makefile")),
+                    reason="native sources not present")
+def test_native_cpp_suite():
+    build = subprocess.run(["make", "-C", SRC, "tests/native_tests"],
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([os.path.join(SRC, "tests", "native_tests")],
+                         capture_output=True, text=True)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "checks passed" in run.stdout
