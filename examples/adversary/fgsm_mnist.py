@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Adversarial examples via FGSM (reference example/adversary/
+adversary_generation.ipynb): train a small MLP, then perturb inputs along
+the sign of the input gradient and measure the accuracy drop.
+
+TPU-native: the input gradient comes from the same tape autograd that
+trains the net (`x.attach_grad(); loss.backward()`), no special API.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def make_data(num, rng):
+    protos = rng.rand(10, 784).astype("f")
+    y = rng.randint(0, 10, num)
+    X = protos[y] + rng.randn(num, 784).astype("f") * 0.05
+    return X.astype("f"), y.astype("f")
+
+
+def build_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def accuracy(net, X, y, batch):
+    correct = 0
+    for i in range(0, len(y), batch):
+        out = net(mx.nd.array(X[i:i + batch])).asnumpy()
+        correct += (out.argmax(axis=1) == y[i:i + batch]).sum()
+    return correct / float(len(y))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--epsilon", type=float, default=0.3)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, y = make_data(args.num_examples, rng)
+    n_train = int(0.8 * len(y))
+
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.num_epochs):
+        total = 0.0
+        for i in range(0, n_train, args.batch_size):
+            data = mx.nd.array(X[i:i + args.batch_size])
+            label = mx.nd.array(y[i:i + args.batch_size])
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += loss.mean().asscalar()
+        print("epoch %d loss %.4f" % (epoch, total / (n_train // args.batch_size)))
+
+    Xt, yt = X[n_train:], y[n_train:]
+    clean_acc = accuracy(net, Xt, yt, args.batch_size)
+
+    # FGSM: x' = x + eps * sign(dL/dx)
+    adv_correct = 0
+    for i in range(0, len(yt), args.batch_size):
+        data = mx.nd.array(Xt[i:i + args.batch_size])
+        label = mx.nd.array(yt[i:i + args.batch_size])
+        data.attach_grad()
+        with autograd.record():
+            loss = loss_fn(net(data), label)
+        loss.backward()
+        adv = data + args.epsilon * mx.nd.sign(data.grad)
+        out = net(adv).asnumpy()
+        adv_correct += (out.argmax(axis=1) == yt[i:i + args.batch_size]).sum()
+    adv_acc = adv_correct / float(len(yt))
+
+    print("clean accuracy %.3f" % clean_acc)
+    print("adversarial accuracy %.3f (eps=%g)" % (adv_acc, args.epsilon))
+    assert adv_acc < clean_acc, "FGSM should reduce accuracy"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Stacked dense autoencoder (reference example/autoencoder: 784-500-500-
+2000-10 encoder mirrored into a decoder, trained end-to-end on
+reconstruction MSE; this config is scaled down and trained directly —
+layer-wise pretraining is a scheduling detail, not a capability).
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--num-epochs", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--dims", type=str, default="256,64,16",
+                   help="encoder layer widths, comma separated")
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    # low-rank structured data: reconstructable through a narrow bottleneck
+    basis = rng.randn(8, 784).astype("f")
+    codes = rng.randn(args.num_examples, 8).astype("f")
+    X = np.tanh(codes @ basis)
+
+    dims = [int(d) for d in args.dims.split(",")]
+    net = gluon.nn.HybridSequential()
+    for d in dims[:-1]:
+        net.add(gluon.nn.Dense(d, activation="relu"))
+    net.add(gluon.nn.Dense(dims[-1]))              # bottleneck code
+    for d in reversed(dims[:-1]):
+        net.add(gluon.nn.Dense(d, activation="relu"))
+    net.add(gluon.nn.Dense(784))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    mse = 0.0
+    for epoch in range(args.num_epochs):
+        total, nb = 0.0, 0
+        for i in range(0, len(X), args.batch_size):
+            data = mx.nd.array(X[i:i + args.batch_size])
+            with autograd.record():
+                loss = loss_fn(net(data), data)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += loss.mean().asscalar()
+            nb += 1
+        mse = total / nb
+        if epoch % 5 == 0 or epoch == args.num_epochs - 1:
+            print("epoch %d reconstruction loss %.5f" % (epoch, mse))
+
+    print("final reconstruction loss %.5f" % mse)
+    assert mse < 0.1, "autoencoder failed to fit low-rank data"
+
+
+if __name__ == "__main__":
+    main()

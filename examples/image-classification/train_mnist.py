@@ -92,7 +92,7 @@ def main():
     if args.model_prefix:
         epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
     mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
-            optimizer="sgd",
+            optimizer="sgd", initializer=mx.init.Xavier(),
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
             kvstore=args.kv_store,
             batch_end_callback=cbs, epoch_end_callback=epoch_cbs,

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Multi-task training (reference example/multi-task/example_multi_task.py:
+one trunk, two softmax heads, joint loss, per-task metrics) on the Module
+API: the Symbol is a Group of two SoftmaxOutputs and the DataIter carries
+two labels.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def build_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    # task 1: 10-way digit; task 2: binary parity
+    fc_digit = mx.sym.FullyConnected(net, name="fc_digit", num_hidden=10)
+    fc_par = mx.sym.FullyConnected(net, name="fc_parity", num_hidden=2)
+    sm1 = mx.sym.SoftmaxOutput(fc_digit, name="softmax_digit")
+    sm2 = mx.sym.SoftmaxOutput(fc_par, name="softmax_parity")
+    return mx.sym.Group([sm1, sm2])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--num-epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 784).astype("f")
+    y = rng.randint(0, 10, args.num_examples)
+    X = protos[y] + rng.randn(args.num_examples, 784).astype("f") * 0.05
+    y_par = (y % 2).astype("f")
+
+    n_train = int(0.8 * args.num_examples)
+    train = mx.io.NDArrayIter(
+        X[:n_train],
+        {"softmax_digit_label": y[:n_train].astype("f"),
+         "softmax_parity_label": y_par[:n_train]},
+        args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(
+        X[n_train:],
+        {"softmax_digit_label": y[n_train:].astype("f"),
+         "softmax_parity_label": y_par[n_train:]},
+        args.batch_size)
+
+    mod = mx.mod.Module(build_symbol(), data_names=["data"],
+                        label_names=["softmax_digit_label",
+                                     "softmax_parity_label"])
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr})
+
+    for epoch in range(args.num_epochs):
+        train.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        print("epoch %d done" % epoch)
+
+    # per-task validation accuracy
+    val.reset()
+    correct = np.zeros(2)
+    count = 0
+    for batch in val:
+        mod.forward(batch, is_train=False)
+        outs = [o.asnumpy() for o in mod.get_outputs()]
+        labels = [l.asnumpy() for l in batch.label]
+        n = outs[0].shape[0] - batch.pad
+        for t in range(2):
+            correct[t] += (outs[t][:n].argmax(axis=1) ==
+                           labels[t][:n]).sum()
+        count += n
+    acc = correct / count
+    print("digit accuracy %.3f parity accuracy %.3f" % (acc[0], acc[1]))
+    assert acc[0] > 0.8 and acc[1] > 0.8
+
+
+if __name__ == "__main__":
+    main()

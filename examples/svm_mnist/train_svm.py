@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Linear + margin classification with SVMOutput (reference
+example/svm_mnist/svm_mnist.py: an MLP whose head is SVMOutput with
+regularization_coefficient, trained by Module.fit)."""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--num-epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--l2", action="store_true",
+                   help="use squared hinge (use_linear=0 analog)")
+    args = p.parse_args()
+
+    rng = np.random.RandomState(7)
+    protos = rng.rand(10, 784).astype("f") * 2
+    y = rng.randint(0, 10, args.num_examples)
+    X = protos[y] + rng.randn(args.num_examples, 784).astype("f") * 0.1
+    X = (X - X.mean(axis=0)) / (X.std(axis=0) + 1e-6)  # standardize like
+    # the reference example's /255 scaling: hinge grads don't self-normalize
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = mx.sym.SVMOutput(net, name="svm",
+                           regularization_coefficient=1.0,
+                           use_linear=not args.l2)
+
+    n_train = int(0.8 * args.num_examples)
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train].astype("f"),
+                              args.batch_size, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(X[n_train:], y[n_train:].astype("f"),
+                            args.batch_size, label_name="svm_label")
+
+    mod = mx.mod.Module(net, label_names=["svm_label"])
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9},
+            eval_metric="acc", num_epoch=args.num_epochs)
+
+    score = mod.score(val, "acc")
+    acc = dict(score)["accuracy"]
+    print("final svm accuracy %.3f" % acc)
+    assert acc > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -96,3 +96,37 @@ def test_deformable_rcnn():
              "--deformable")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "FASTER-RCNN FLOW OK" in r.stdout
+
+
+def test_adversary_fgsm():
+    r = _run("adversary/fgsm_mnist.py", "--num-examples", "600",
+             "--num-epochs", "3")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "adversarial accuracy" in r.stdout
+
+
+def test_autoencoder():
+    r = _run("autoencoder/train_autoencoder.py", "--num-examples", "600",
+             "--num-epochs", "12")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final reconstruction loss" in r.stdout
+
+
+def test_gan():
+    r = _run("gan/train_gan.py", "--num-iters", "250")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sample mean" in r.stdout
+
+
+def test_multitask():
+    r = _run("multi-task/train_multitask.py", "--num-examples", "800",
+             "--num-epochs", "5")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "parity accuracy" in r.stdout
+
+
+def test_svm_mnist():
+    r = _run("svm_mnist/train_svm.py", "--num-examples", "800",
+             "--num-epochs", "6")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final svm accuracy" in r.stdout
