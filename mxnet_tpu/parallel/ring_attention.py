@@ -72,13 +72,20 @@ def _ring_body(q, k, v, *, axis, causal, scale):
 
     qpos = idx * tq + jnp.arange(tq)
 
+    # accumulate in f32 regardless of low-precision input dtype (bf16 on
+    # TPU): the running sum l and accumulator o add n partial results, and
+    # _NEG overflows fp16. f64 inputs (x64 mode) promote the accumulators
+    # instead — mixing f64 blocks into f32 carries would flip the carry
+    # dtype mid-loop.
+    acc_t = jnp.promote_types(jnp.float32, q.dtype)
+
     def step(t, carry):
         o, l, m, k, v = carry
         # after t rotations device `idx` holds the block that started on
         # device (idx - t) mod n
         src = (idx - t) % n
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) * scale_
+                       preferred_element_type=acc_t) * scale_
         if causal:
             kpos = src * tk + jnp.arange(tk)
             mask = qpos[:, None] >= kpos[None, :]
@@ -87,17 +94,15 @@ def _ring_body(q, k, v, *, axis, causal, scale):
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v,
+                                              preferred_element_type=acc_t)
         k = lax.ppermute(k, axis, perm)
         v = lax.ppermute(v, axis, perm)
         return o, l, m_new, k, v
 
-    # accumulate in f32 regardless of input dtype (bf16 inputs on TPU):
-    # the running sum l and accumulator o add n partial results, and
-    # _NEG overflows fp16
-    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
-    l0 = jnp.zeros((b, h, tq), jnp.float32)
-    m0 = jnp.full((b, h, tq), _NEG, jnp.float32)
+    o0 = jnp.zeros((b, h, tq, d), acc_t)
+    l0 = jnp.zeros((b, h, tq), acc_t)
+    m0 = jnp.full((b, h, tq), _NEG, acc_t)
     o, l, _, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
     l = jnp.where(l == 0, 1.0, l)  # defensive; l>0 after the diagonal block
     o = (o / l[..., None]).astype(q.dtype)
