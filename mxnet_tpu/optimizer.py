@@ -650,7 +650,13 @@ class FusedApplier:
                     new_states.append(tuple(outs[1:]))
                 return new_ws, new_states
 
-            fn = jax.jit(apply_all)
+            # donate the optimizer states (adam m/v, momentum): they are
+            # internal to the Updater and rebound to the returned buffers
+            # below, so XLA updates them in place (the reference's
+            # kWriteInplace optimizer kernels). Weights are NOT donated —
+            # user code may hold views of the old weight buffers, which
+            # donation would invalidate.
+            fn = jax.jit(apply_all, donate_argnums=(5,))
             self._jit_cache[key] = fn
 
         new_ws, new_states = fn(lrs, wds, rescale, w_vals, g_vals,
