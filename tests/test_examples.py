@@ -130,3 +130,10 @@ def test_svm_mnist():
              "--num-epochs", "6")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "final svm accuracy" in r.stdout
+
+
+def test_long_context_ring_lm():
+    r = _run("long-context/train_long_lm.py", "--seq-len", "256",
+             "--steps", "20", "--dim", "32", "--layers", "1")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "LONG-CONTEXT TRAINING OK" in r.stdout
