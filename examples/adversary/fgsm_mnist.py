@@ -64,7 +64,7 @@ def main():
                             {"learning_rate": args.lr})
 
     for epoch in range(args.num_epochs):
-        total = 0.0
+        total, nb = 0.0, 0
         for i in range(0, n_train, args.batch_size):
             data = mx.nd.array(X[i:i + args.batch_size])
             label = mx.nd.array(y[i:i + args.batch_size])
@@ -73,7 +73,8 @@ def main():
             loss.backward()
             trainer.step(data.shape[0])
             total += loss.mean().asscalar()
-        print("epoch %d loss %.4f" % (epoch, total / (n_train // args.batch_size)))
+            nb += 1
+        print("epoch %d loss %.4f" % (epoch, total / nb))
 
     Xt, yt = X[n_train:], y[n_train:]
     clean_acc = accuracy(net, Xt, yt, args.batch_size)
