@@ -72,13 +72,14 @@ def _convolution(params, data, weight, *bias):
     pad = _tup(params.get("pad"), nd, 0)
     groups = params.get("num_group", 1)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(nd))
+    # no preferred_element_type: the TPU MXU accumulates bf16 convs in f32
+    # natively, and forcing f32 here leaks an f32 cotangent into the conv
+    # transpose rule, which rejects mixed bf16/f32 operands under grad
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    out = out.astype(data.dtype)
+        feature_group_count=groups)
     if not params.get("no_bias", False) and bias:
         out = out + bias[0].reshape((1, -1) + (1,) * nd)
     return (out,)
