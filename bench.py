@@ -29,8 +29,6 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
 
-    # iters amortizes the one ~90ms host scalar-read sync per timed call
-    # (the only reliable barrier through a relayed backend) to <2% bias
     batch, iters = 32, 100
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     net = vision.resnet50_v1()
@@ -46,26 +44,34 @@ def main():
     key = jax.random.PRNGKey(0)
 
     @jax.jit
-    def loop(pv, xv):
+    def loop(pv, xv, acc0):
         # roll the batch each iteration so the forward depends on the loop
         # counter — otherwise XLA's invariant code motion hoists the whole
         # network out of the loop and we'd time ONE forward, not `iters`
         def body(i, acc):
             xi = jnp.roll(xv, i, axis=0)
             return acc + cached(pv, key, False, xi)[0].sum()
-        return lax.fori_loop(0, iters, body, jnp.float32(0))
+        return lax.fori_loop(0, iters, body, acc0)
 
     xv = x._data
-    # sync by READING the scalar result: block_until_ready can be a
-    # fast-path no-op on relayed PJRT backends, which would time dispatch
-    # instead of execution
-    float(loop(params, xv))  # compile
+    # Sync discipline: block_until_ready is a fast-path no-op on relayed
+    # PJRT backends, and the only barrier that provably waits is READING a
+    # result scalar (~90ms through the tunnel). One read per timed call
+    # would bias the rate, so each timed round chains `calls` loop
+    # invocations through the accumulator (a data dependency, so the device
+    # must run them back-to-back) and reads once: bias ~= 90ms over the
+    # whole round, ~2-3% at the rates measured here.
+    calls = 8
+    float(loop(params, xv, jnp.float32(0)))  # compile
     best = 0.0
-    for _ in range(3):
+    for _ in range(2):
         t0 = time.time()
-        float(loop(params, xv))
+        acc = jnp.float32(0)
+        for _ in range(calls):
+            acc = loop(params, xv, acc)
+        float(acc)
         dt = time.time() - t0
-        best = max(best, batch * iters / dt)
+        best = max(best, batch * iters * calls / dt)
 
     print(json.dumps({
         "metric": "resnet50_infer_imgs_per_sec_bs32",
