@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""CNN text classification (reference example/cnn_text_classification:
+Kim-2014-style multi-width Conv1D over token embeddings, max-over-time
+pooling, dense head). Synthetic data: class = which trigger n-gram appears
+in the sequence, so the conv filters must learn local patterns.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+class TextCNN(gluon.HybridBlock):
+    def __init__(self, vocab, embed, num_filter, widths, classes):
+        super().__init__()
+        self.embedding = gluon.nn.Embedding(vocab, embed)
+        self.convs = []
+        for i, w in enumerate(widths):
+            conv = gluon.nn.Conv1D(num_filter, w, activation="relu")
+            setattr(self, "conv%d" % i, conv)   # register child
+            self.convs.append(conv)
+        self.pool = gluon.nn.GlobalMaxPool1D()
+        self.dropout = gluon.nn.Dropout(0.3)
+        self.out = gluon.nn.Dense(classes)
+
+    def hybrid_forward(self, F, toks):
+        x = self.embedding(toks)                 # (B, T, E)
+        x = x.transpose((0, 2, 1))               # Conv1D wants NCW
+        feats = [self.pool(c(x)).reshape((0, -1)) for c in self.convs]
+        h = F.concat(*feats, dim=1)
+        return self.out(self.dropout(h))
+
+
+def make_data(num, seq_len, vocab, classes, rng):
+    # class c is signalled by trigger bigram (2c+10, 2c+11) at a random pos
+    toks = rng.randint(20, vocab, (num, seq_len))
+    y = rng.randint(0, classes, num)
+    pos = rng.randint(0, seq_len - 2, num)
+    for i in range(num):
+        toks[i, pos[i]] = 2 * y[i] + 10
+        toks[i, pos[i] + 1] = 2 * y[i] + 11
+    return toks.astype("f"), y.astype("f")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--seq-len", type=int, default=30)
+    p.add_argument("--vocab", type=int, default=200)
+    p.add_argument("--classes", type=int, default=4)
+    p.add_argument("--num-epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--lr", type=float, default=3e-3)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, y = make_data(args.num_examples, args.seq_len, args.vocab,
+                     args.classes, rng)
+    n_train = int(0.8 * len(y))
+
+    net = TextCNN(args.vocab, 32, 16, (2, 3, 4), args.classes)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.num_epochs):
+        total, nb = 0.0, 0
+        for i in range(0, n_train, args.batch_size):
+            data = mx.nd.array(X[i:i + args.batch_size])
+            label = mx.nd.array(y[i:i + args.batch_size])
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += loss.mean().asscalar()
+            nb += 1
+        print("epoch %d loss %.4f" % (epoch, total / nb))
+
+    correct = 0
+    for i in range(n_train, len(y), args.batch_size):
+        out = net(mx.nd.array(X[i:i + args.batch_size])).asnumpy()
+        correct += (out.argmax(1) == y[i:i + args.batch_size]).sum()
+    acc = correct / float(len(y) - n_train)
+    print("final text-cnn accuracy %.3f" % acc)
+    assert acc > 0.8
+
+
+if __name__ == "__main__":
+    main()

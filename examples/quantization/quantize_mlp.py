@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Post-training int8 quantization (reference example/quantization +
+python/mxnet/contrib/quantization.py): train an MLP in fp32, quantize
+FullyConnected layers to int8 with naive or entropy calibration, and
+compare fp32 vs int8 accuracy.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as q
+
+
+def build_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def accuracy(sym, params, X, y, batch):
+    ex = sym.simple_bind(mx.cpu(), grad_req="null",
+                         data=(batch, X.shape[1]))
+    for k, v in params.items():
+        if k in ex.arg_dict:
+            ex.arg_dict[k][:] = v
+    correct = 0
+    for i in range(0, len(y) - batch + 1, batch):
+        ex.arg_dict["data"][:] = X[i:i + batch]
+        out = ex.forward(is_train=False)[0].asnumpy()
+        correct += (out.argmax(1) == y[i:i + batch]).sum()
+    return correct / float((len(y) // batch) * batch)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=2000)
+    p.add_argument("--num-epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--calib-mode", type=str, default="naive",
+                   choices=["none", "naive", "entropy"])
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 64).astype("f")
+    y = rng.randint(0, 10, args.num_examples)
+    X = protos[y] + rng.randn(args.num_examples, 64).astype("f") * 0.05
+    n_train = int(0.8 * args.num_examples)
+
+    sym = build_sym()
+    train = mx.io.NDArrayIter(X[:n_train], y[:n_train].astype("f"),
+                              args.batch_size, shuffle=True)
+    mod = mx.mod.Module(sym)
+    mod.fit(train, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5},
+            num_epoch=args.num_epochs)
+    arg_params, aux_params = mod.get_params()
+
+    Xt, yt = X[n_train:], y[n_train:]
+    fp32_acc = accuracy(sym, arg_params, Xt, yt, args.batch_size)
+
+    calib = mx.io.NDArrayIter(X[:500], y[:500].astype("f"),
+                              args.batch_size)
+    qsym, qarg, qaux = q.quantize_model(
+        sym, arg_params, aux_params, calib_mode=args.calib_mode,
+        calib_data=calib, num_calib_examples=500)
+    int8_acc = accuracy(qsym, qarg, Xt, yt, args.batch_size)
+
+    print("fp32 accuracy %.3f" % fp32_acc)
+    print("int8 accuracy %.3f (calib_mode=%s)" % (int8_acc, args.calib_mode))
+    assert int8_acc > fp32_acc - 0.05, "int8 accuracy dropped too far"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender (reference example/recommenders/
+demo1-MF.ipynb: user/item embeddings, dot-product score, L2 loss on
+ratings). Synthetic low-rank rating matrix so the factorization is
+recoverable.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+class MFBlock(gluon.HybridBlock):
+    def __init__(self, n_users, n_items, k):
+        super().__init__()
+        self.user_emb = gluon.nn.Embedding(n_users, k)
+        self.item_emb = gluon.nn.Embedding(n_items, k)
+        self.user_bias = gluon.nn.Embedding(n_users, 1)
+        self.item_bias = gluon.nn.Embedding(n_items, 1)
+
+    def hybrid_forward(self, F, users, items):
+        p = self.user_emb(users)
+        q = self.item_emb(items)
+        score = (p * q).sum(axis=-1)
+        return score + self.user_bias(users).reshape((-1,)) \
+            + self.item_bias(items).reshape((-1,))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-users", type=int, default=200)
+    p.add_argument("--num-items", type=int, default=150)
+    p.add_argument("--rank", type=int, default=6)
+    p.add_argument("--num-ratings", type=int, default=8000)
+    p.add_argument("--num-epochs", type=int, default=15)
+    p.add_argument("--batch-size", type=int, default=500)
+    p.add_argument("--lr", type=float, default=0.02)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    U = rng.randn(args.num_users, args.rank).astype("f") * 0.8
+    V = rng.randn(args.num_items, args.rank).astype("f") * 0.8
+    users = rng.randint(0, args.num_users, args.num_ratings)
+    items = rng.randint(0, args.num_items, args.num_ratings)
+    ratings = (U[users] * V[items]).sum(1) + \
+        rng.randn(args.num_ratings).astype("f") * 0.05
+    n_train = int(0.9 * args.num_ratings)
+
+    net = MFBlock(args.num_users, args.num_items, args.rank)
+    net.initialize(mx.initializer.Normal(0.1))
+    net.hybridize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    mse = None
+    for epoch in range(args.num_epochs):
+        perm = rng.permutation(n_train)
+        total, nb = 0.0, 0
+        for i in range(0, n_train, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            u = mx.nd.array(users[idx])
+            it = mx.nd.array(items[idx])
+            r = mx.nd.array(ratings[idx])
+            with autograd.record():
+                loss = loss_fn(net(u, it), r)
+            loss.backward()
+            trainer.step(len(idx))
+            total += loss.mean().asscalar()
+            nb += 1
+        if epoch % 5 == 0:
+            print("epoch %d train loss %.4f" % (epoch, total / nb))
+
+    pred = net(mx.nd.array(users[n_train:]),
+               mx.nd.array(items[n_train:])).asnumpy()
+    mse = float(np.mean((pred - ratings[n_train:]) ** 2))
+    print("final test mse %.4f" % mse)
+    assert mse < 0.5, "MF failed to recover the low-rank structure"
+
+
+if __name__ == "__main__":
+    main()

@@ -137,3 +137,23 @@ def test_long_context_ring_lm():
              "--steps", "20", "--dim", "32", "--layers", "1")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "LONG-CONTEXT TRAINING OK" in r.stdout
+
+
+def test_cnn_text_classification():
+    r = _run("cnn_text_classification/train_cnn_text.py",
+             "--num-examples", "1000", "--num-epochs", "4")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final text-cnn accuracy" in r.stdout
+
+
+def test_recommender_mf():
+    r = _run("recommenders/train_mf.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final test mse" in r.stdout
+
+
+def test_quantization_example():
+    r = _run("quantization/quantize_mlp.py", "--num-examples", "1200",
+             "--num-epochs", "5")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "int8 accuracy" in r.stdout
