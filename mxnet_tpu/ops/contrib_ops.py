@@ -170,3 +170,21 @@ def _roi_pooling(params, data, rois):
 
     out = jax.vmap(pool_one)(rois)
     return (out.astype(data.dtype),)
+
+
+@register("_contrib_flash_attention", aliases=("flash_attention",))
+def _flash_attention_op(params, q, k, v):
+    """Fused multi-head attention (Pallas flash kernel on TPU, interpreter
+    elsewhere). Inputs [B, T, H, D]; new capability — the reference has no
+    attention op (its sequence stack is cudnn_rnn, SURVEY §2.4). Attrs:
+    causal (bool), scale (float, default 1/sqrt(D)), block_q/block_k
+    (kernel tile sizes)."""
+    from .pallas_kernels import flash_attention
+    from .nn import _attr_bool, _attr_num
+    causal = _attr_bool(params, "causal")
+    scale = params.get("scale")
+    scale = None if scale in (None, "None") else float(scale)
+    block_q = int(_attr_num(params, "block_q", 512))
+    block_k = int(_attr_num(params, "block_k", 512))
+    return (flash_attention(q, k, v, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k),)

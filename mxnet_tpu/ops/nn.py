@@ -616,9 +616,33 @@ def _unpack_rnn_params(flat, num_layers, input_size, state_size, bidir, mode):
     return weights, biases
 
 
+def _fused_lstm_ok(h0):
+    """Use the Pallas fused-LSTM kernel (the cuDNN-RNN analog) when the
+    platform compiles it for real (TPU) and the per-step working set fits
+    comfortably in VMEM; otherwise lax.scan."""
+    from .pallas_kernels import is_tpu
+    if not is_tpu():
+        return False
+    B, H = h0.shape
+    # gates block (B x 4H) + h/c scratch + recurrent weights, f32
+    vmem = (B * 4 * H + 2 * B * H + H * 4 * H) * 4
+    return vmem <= 8 * 1024 * 1024
+
+
 def _rnn_cell_scan(mode, x_seq, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse=False):
     """One direction of one layer. x_seq (T,B,I) -> (T,B,H)."""
     H = h0.shape[-1]
+
+    if mode == "lstm" and _fused_lstm_ok(h0):
+        from .pallas_kernels import fused_lstm
+        xs = jnp.flip(x_seq, 0) if reverse else x_seq
+        # fused_lstm casts to its f32 working precision internally and
+        # returns x's dtype
+        ys, h_f, c_f = fused_lstm(xs, h0, c0, w_i2h.T, w_h2h.T,
+                                  b_i2h + b_h2h)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, h_f, c_f
 
     def cell(carry, x_t):
         h, c = carry

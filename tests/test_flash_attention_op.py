@@ -1,0 +1,57 @@
+"""_contrib_flash_attention op: nd/symbol/grad integration (the kernel
+itself is covered by tests/test_pallas.py; this is the registry surface)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _oracle(q, k, v, causal):
+    B, T, H, D = q.shape
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_nd_matches_oracle(causal):
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(2, 16, 4, 8).astype("f") for _ in range(3))
+    out = mx.nd.contrib.flash_attention(
+        mx.nd.array(q), mx.nd.array(k), mx.nd.array(v), causal=causal)
+    np.testing.assert_allclose(out.asnumpy(), _oracle(q, k, v, causal),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_flows():
+    rng = np.random.RandomState(1)
+    q = mx.nd.array(rng.randn(1, 8, 2, 8).astype("f"))
+    k = mx.nd.array(rng.randn(1, 8, 2, 8).astype("f"))
+    v = mx.nd.array(rng.randn(1, 8, 2, 8).astype("f"))
+    for x in (q, k, v):
+        x.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.flash_attention(q, k, v, causal=True)
+    out.backward()
+    for x in (q, k, v):
+        g = x.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_symbol_binds():
+    rng = np.random.RandomState(2)
+    qn, kn, vn = (rng.randn(2, 12, 2, 8).astype("f") for _ in range(3))
+    sym = mx.sym.contrib.flash_attention(
+        mx.sym.var("q"), mx.sym.var("k"), mx.sym.var("v"), causal=False)
+    ex = sym.simple_bind(mx.cpu(), q=qn.shape, k=kn.shape, v=vn.shape)
+    ex.arg_dict["q"][:] = qn
+    ex.arg_dict["k"][:] = kn
+    ex.arg_dict["v"][:] = vn
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, _oracle(qn, kn, vn, False),
+                               rtol=1e-4, atol=1e-5)
