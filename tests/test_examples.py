@@ -157,3 +157,17 @@ def test_quantization_example():
              "--num-epochs", "5")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "int8 accuracy" in r.stdout
+
+
+def test_ctc_ocr():
+    r = _run("ctc/train_ctc_ocr.py", "--num-examples", "600",
+             "--num-epochs", "20")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sequence accuracy" in r.stdout
+
+
+def test_vae():
+    r = _run("vae/train_vae.py", "--num-examples", "1000",
+             "--num-epochs", "15")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "VAE TRAINING OK" in r.stdout

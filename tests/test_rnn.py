@@ -250,3 +250,32 @@ def test_gluon_lstmp_cell():
     out, new_states = cell(x, states)
     assert out.shape == (2, 8)           # projected
     assert new_states[1].shape == (2, 16)  # cell state full-size
+
+
+def test_rnn_fused_lstm_dispatch_matches_scan():
+    """The TPU fused-LSTM fast path's wiring (weight transposes, bias sum,
+    reverse flip) must match the lax.scan path; forced through the Pallas
+    interpreter since CI has no chip."""
+    import numpy as np
+    from mxnet_tpu.ops import nn as nn_ops
+
+    rng = np.random.RandomState(0)
+    T, B, I, H = 12, 4, 8, 16
+    x = mx.nd.array(rng.randn(T, B, I).astype("f"))
+    w = mx.nd.array(rng.randn((I * 4 * H) + (H * 4 * H) + 8 * H)
+                    .astype("f") * 0.1)
+    h0 = mx.nd.zeros((2, B, H))
+    c0 = mx.nd.zeros((2, B, H))
+
+    def run():
+        return mx.nd.RNN(x, w, h0, c0, state_size=H, num_layers=1,
+                         mode="lstm", bidirectional=True).asnumpy()
+
+    scan_out = run()
+    saved = nn_ops._fused_lstm_ok
+    nn_ops._fused_lstm_ok = lambda *a: True   # force the fused path
+    try:
+        fused_out = run()
+    finally:
+        nn_ops._fused_lstm_ok = saved
+    np.testing.assert_allclose(fused_out, scan_out, rtol=1e-4, atol=1e-5)
