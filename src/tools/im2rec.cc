@@ -1,0 +1,163 @@
+// Native im2rec: pack a .lst of images into RecordIO (+.idx).
+// Reference: tools/im2rec.cc there (OpenCV + dmlc recordio); this version
+// rides libmxtpu's codec/recordio. CLI contract (subset):
+//
+//   im2rec <prefix.lst> <image_root> <out_prefix> [--resize N]
+//          [--quality Q] [--center-crop]
+//
+// .lst line: index \t label(s...) \t relative_path
+// Record payload: IRHeader{flag=0|nlabel, label, id, 0} + JPEG bytes.
+// Extra labels (flag>0) are stored as floats after the header like the
+// reference's pack_label mode.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../c_api.h"
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+static void Fail(const char *what) {
+  std::fprintf(stderr, "im2rec: %s: %s\n", what, MXTGetLastError());
+  std::exit(1);
+}
+
+static std::vector<unsigned char> ReadFile(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return {};
+  return std::vector<unsigned char>(std::istreambuf_iterator<char>(f), {});
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: im2rec <list.lst> <image_root> <out_prefix> "
+                 "[--resize N] [--quality Q] [--center-crop]\n");
+    return 1;
+  }
+  std::string lst_path = argv[1], root = argv[2], prefix = argv[3];
+  int resize = 0, quality = 95;
+  bool center_crop = false;
+  for (int i = 4; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--resize" && i + 1 < argc) resize = std::atoi(argv[++i]);
+    else if (a == "--quality" && i + 1 < argc) quality = std::atoi(argv[++i]);
+    else if (a == "--center-crop") center_crop = true;
+  }
+  if (!root.empty() && root.back() != '/') root += '/';
+
+  std::ifstream lst(lst_path);
+  if (!lst) {
+    std::fprintf(stderr, "im2rec: cannot open %s\n", lst_path.c_str());
+    return 1;
+  }
+
+  RecordIOHandle w = nullptr;
+  if (MXTRecordIOWriterCreate((prefix + ".rec").c_str(), &w) != 0)
+    Fail("create rec");
+  std::ofstream idx(prefix + ".idx");
+
+  std::string line;
+  size_t count = 0, errors = 0;
+  while (std::getline(lst, line)) {
+    if (line.empty()) continue;
+    std::vector<std::string> cols;
+    std::stringstream ss(line);
+    std::string col;
+    while (std::getline(ss, col, '\t')) cols.push_back(col);
+    if (cols.size() < 3) { ++errors; continue; }
+    uint64_t id = std::strtoull(cols[0].c_str(), nullptr, 10);
+    std::string path = cols.back();
+    std::vector<float> labels;
+    for (size_t i = 1; i + 1 < cols.size(); ++i)
+      labels.push_back(std::strtof(cols[i].c_str(), nullptr));
+
+    std::vector<unsigned char> buf = ReadFile(root + path);
+    if (buf.empty()) {
+      std::fprintf(stderr, "im2rec: missing %s\n", (root + path).c_str());
+      ++errors;
+      continue;
+    }
+
+    std::string payload;
+    if (resize > 0 || center_crop) {
+      int h = 0, wid = 0, c = 0;
+      if (MXTImageDecode(reinterpret_cast<const char *>(buf.data()),
+                         buf.size(), 1, &h, &wid, &c, nullptr) != 0) {
+        ++errors;
+        continue;
+      }
+      std::vector<unsigned char> img(static_cast<size_t>(h) * wid * c);
+      MXTImageDecode(reinterpret_cast<const char *>(buf.data()), buf.size(),
+                     1, &h, &wid, &c, img.data());
+      if (resize > 0) {
+        // short-edge resize (reference im2rec --resize semantics)
+        int nh = h, nw = wid;
+        if (h < wid) { nh = resize; nw = wid * resize / h; }
+        else { nw = resize; nh = h * resize / wid; }
+        std::vector<unsigned char> out(static_cast<size_t>(nh) * nw * c);
+        MXTImageResize(img.data(), h, wid, c, out.data(), nh, nw);
+        img.swap(out);
+        h = nh;
+        wid = nw;
+      }
+      if (center_crop && h != wid) {
+        int s = h < wid ? h : wid;
+        int y0 = (h - s) / 2, x0 = (wid - s) / 2;
+        std::vector<unsigned char> out(static_cast<size_t>(s) * s * c);
+        for (int y = 0; y < s; ++y)
+          std::memcpy(&out[static_cast<size_t>(y) * s * c],
+                      &img[(static_cast<size_t>(y0 + y) * wid + x0) * c],
+                      static_cast<size_t>(s) * c);
+        img.swap(out);
+        h = wid = s;
+      }
+      size_t cap = 0;
+      if (MXTImageEncodeJPEG(img.data(), h, wid, c, quality, nullptr,
+                             &cap) != 0)
+        Fail("encode");
+      payload.resize(cap);
+      size_t size = cap;
+      MXTImageEncodeJPEG(img.data(), h, wid, c, quality, &payload[0], &size);
+      payload.resize(size);
+    } else {
+      payload.assign(buf.begin(), buf.end());  // pack original bytes
+    }
+
+    IRHeader header;
+    header.flag = labels.size() > 1 ? static_cast<uint32_t>(labels.size()) : 0;
+    header.label = labels.empty() ? 0.f : labels[0];
+    header.id = id;
+    header.id2 = 0;
+    std::string rec(reinterpret_cast<const char *>(&header), sizeof(header));
+    if (header.flag > 0)
+      rec.append(reinterpret_cast<const char *>(labels.data()),
+                 labels.size() * sizeof(float));
+    rec.append(payload);
+
+    size_t pos = 0;
+    MXTRecordIOWriterTell(w, &pos);
+    if (MXTRecordIOWriterWriteRecord(w, rec.data(), rec.size()) != 0)
+      Fail("write");
+    idx << id << "\t" << pos << "\n";
+    ++count;
+    if (count % 1000 == 0)
+      std::fprintf(stderr, "im2rec: packed %zu\n", count);
+  }
+  MXTRecordIOWriterFree(w);
+  std::printf("im2rec: wrote %zu records (%zu errors) to %s.rec\n", count,
+              errors, prefix.c_str());
+  return errors && !count ? 1 : 0;
+}

@@ -160,8 +160,8 @@ def test_quantization_example():
 
 
 def test_ctc_ocr():
-    r = _run("ctc/train_ctc_ocr.py", "--num-examples", "600",
-             "--num-epochs", "20")
+    r = _run("ctc/train_ctc_ocr.py", "--num-examples", "800",
+             "--num-epochs", "25", timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "sequence accuracy" in r.stdout
 

@@ -1,0 +1,45 @@
+"""Native im2rec CLI (reference tools/im2rec.cc): build it, pack images,
+read the .rec/.idx back through the framework's record IO."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.image.codec import imencode
+
+SRC = os.path.join(os.path.dirname(__file__), os.pardir, "src")
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(SRC, "Makefile")),
+                    reason="native sources not present")
+def test_native_im2rec_roundtrip(tmp_path):
+    build = subprocess.run(["make", "-C", SRC, "tools/im2rec"],
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    imgs = tmp_path / "imgs"
+    imgs.mkdir()
+    rng = np.random.RandomState(0)
+    with open(tmp_path / "data.lst", "w") as lst:
+        for i in range(5):
+            img = (rng.rand(20, 24, 3) * 255).astype("u1")
+            (imgs / ("i%d.jpg" % i)).write_bytes(imencode(img, quality=95))
+            lst.write("%d\t%d\timgs/i%d.jpg\n" % (i, i % 3, i))
+
+    r = subprocess.run(
+        [os.path.join(SRC, "tools", "im2rec"), str(tmp_path / "data.lst"),
+         str(tmp_path), str(tmp_path / "out"), "--resize", "16"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "wrote 5 records (0 errors)" in r.stdout
+
+    rec = mx.recordio.MXIndexedRecordIO(str(tmp_path / "out.idx"),
+                                        str(tmp_path / "out.rec"), "r")
+    for i in range(5):
+        header, img = mx.recordio.unpack_img(rec.read_idx(i))
+        assert header.id == i
+        assert float(header.label) == i % 3
+        assert min(img.shape[:2]) == 16

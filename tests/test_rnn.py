@@ -262,7 +262,8 @@ def test_rnn_fused_lstm_dispatch_matches_scan():
     rng = np.random.RandomState(0)
     T, B, I, H = 12, 4, 8, 16
     x = mx.nd.array(rng.randn(T, B, I).astype("f"))
-    w = mx.nd.array(rng.randn((I * 4 * H) + (H * 4 * H) + 8 * H)
+    # bidirectional: two directions' worth of packed weights
+    w = mx.nd.array(rng.randn(2 * ((I * 4 * H) + (H * 4 * H) + 8 * H))
                     .astype("f") * 0.1)
     h0 = mx.nd.zeros((2, B, H))
     c0 = mx.nd.zeros((2, B, H))
