@@ -78,6 +78,12 @@ class Trainer:
             for i, param in enumerate(self._params):
                 if param._data is not None:
                     self._kvstore.init(i, param.data())
+                    if self._kvstore.num_workers > 1:
+                        # pull rank 0's broadcast init into the parameter
+                        # (reference Trainer._init_kvstore pulls after
+                        # init) — without this, update_on_kvstore=False
+                        # workers train forever on divergent local inits
+                        self._kvstore.pull(i, out=param.data())
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True

@@ -81,7 +81,16 @@ class KVStore:
             if k in self._store:
                 raise MXNetError("key %s already initialized" % str(k))
             vv = v[0] if isinstance(v, list) else v
-            self._store[k] = vv.copy()
+            vv = vv.copy()
+            if self.num_workers > 1:
+                # reference dist kvstore init seeds the server once and
+                # every worker pulls the SAME value (kvstore_dist.h
+                # InitImpl: only rank 0's payload lands) — broadcast rank
+                # 0's value so workers start from identical params even
+                # when their local initializers drew different numbers
+                from .parallel import dist
+                vv = dist.broadcast_nd(vv)
+            self._store[k] = vv
 
     def push(self, key, value, priority=0):
         keys, values = _normalize(key, value)

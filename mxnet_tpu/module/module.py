@@ -333,6 +333,11 @@ class Module(BaseModule):
                 kvstore.set_gradient_compression(self._compression_params)
             for i, name in enumerate(self._param_names):
                 kvstore.init(i, self._exec.arg_dict[name])
+                if kvstore.num_workers > 1:
+                    # pull rank 0's broadcast init back into the training
+                    # arrays (reference _initialize_kvstore pulls after
+                    # init) so every worker starts from identical params
+                    kvstore.pull(i, out=self._exec.arg_dict[name])
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
         if not update_on_kvstore:

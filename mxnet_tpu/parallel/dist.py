@@ -54,6 +54,18 @@ def allreduce_nd(nd):
     return NDArray(gathered.sum(axis=0), ctx=nd.context)
 
 
+def broadcast_nd(nd):
+    """Replicate rank 0's NDArray value to every process (reference dist
+    kvstore init semantics: only rank 0's payload seeds the server)."""
+    if jax.process_count() == 1:
+        return nd
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from ..ndarray.ndarray import NDArray
+    out = multihost_utils.broadcast_one_to_all(np.asarray(nd._data))
+    return NDArray(np.asarray(out), ctx=nd.context)
+
+
 def barrier():
     if jax.process_count() == 1:
         return

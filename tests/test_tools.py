@@ -138,3 +138,107 @@ def test_launch_dist_sync_kvstore(tmp_path):
         capture_output=True, text=True, env=env, timeout=300)
     assert r.returncode == 0, r.stderr + r.stdout
     assert r.stdout.count("OK") == 2
+
+
+def test_launch_dist_training_converges(tmp_path):
+    """2-process data-parallel Module training over dist_sync — the
+    reference's tests/nightly/dist_lenet.py convergence check run with
+    the local launcher. Each worker fits its shard; synced params must
+    classify the full set."""
+    worker = tmp_path / "train_worker.py"
+    worker.write_text(
+        "import os\n"
+        "os.environ.setdefault('PALLAS_AXON_POOL_IPS', '')\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu.parallel import dist\n"
+        "dist.init()\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "rank, nw = kv.rank, kv.num_workers\n"
+        "rng = np.random.RandomState(0)\n"
+        "protos = rng.rand(4, 16).astype('f') * 2\n"
+        "y = rng.randint(0, 4, 800)\n"
+        "X = protos[y] + rng.randn(800, 16).astype('f') * 0.1\n"
+        "sl = slice(rank * 400, (rank + 1) * 400)  # worker shard\n"
+        "train = mx.io.NDArrayIter(X[sl], y[sl].astype('f'), 50,\n"
+        "                          shuffle=True)\n"
+        "data = mx.sym.var('data')\n"
+        "net = mx.sym.FullyConnected(data, num_hidden=32, name='fc1')\n"
+        "net = mx.sym.Activation(net, act_type='relu')\n"
+        "net = mx.sym.FullyConnected(net, num_hidden=4, name='fc2')\n"
+        "net = mx.sym.SoftmaxOutput(net, name='softmax')\n"
+        "mod = mx.mod.Module(net)\n"
+        "mod.fit(train, optimizer='sgd', initializer=mx.init.Xavier(),\n"
+        "        optimizer_params={'learning_rate': 0.3}, num_epoch=6,\n"
+        "        kvstore=kv)\n"
+        "val = mx.io.NDArrayIter(X, y.astype('f'), 50)\n"
+        "acc = dict(mod.score(val, 'acc'))['accuracy']\n"
+        "assert acc > 0.9, acc\n"
+        "# params must be identical across workers after sync training;\n"
+        "# each worker prints a digest and the harness compares them\n"
+        "arg_params, _ = mod.get_params()\n"
+        "w = arg_params['fc1_weight'].asnumpy()\n"
+        "digest = float(np.abs(w).sum())\n"
+        "print('DIGEST %.6f' % digest)\n"
+        "print('DIST TRAIN', rank, 'acc %.3f OK' % acc)\n")
+    env = dict(os.environ, PYTHONPATH=os.path.join(TOOLS, ".."))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", "2",
+         "--port", "9443", "--", sys.executable, str(worker)],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert r.stdout.count("OK") == 2
+    digests = [l.split()[1] for l in r.stdout.splitlines()
+               if l.startswith("DIGEST")]
+    assert len(digests) == 2 and digests[0] == digests[1], digests
+
+
+def test_launch_dist_gluon_trainer_local_update(tmp_path):
+    """2-process gluon Trainer with update_on_kvstore=False: gradients
+    sync through the store while the updater runs locally — workers must
+    still end bit-identical, which requires the rank-0 init broadcast +
+    pull-after-init (reference Trainer._init_kvstore)."""
+    worker = tmp_path / "gluon_worker.py"
+    worker.write_text(
+        "import os\n"
+        "os.environ.setdefault('PALLAS_AXON_POOL_IPS', '')\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import autograd, gluon\n"
+        "from mxnet_tpu.parallel import dist\n"
+        "dist.init()\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "rank = kv.rank\n"
+        "rng = np.random.RandomState(100 + rank)  # divergent local init\n"
+        "mx.random.seed(100 + rank)\n"
+        "X = rng.rand(200, 8).astype('f')\n"
+        "y = (X.sum(1) > 4).astype('f')\n"
+        "net = gluon.nn.Dense(1)\n"
+        "net.initialize(mx.init.Xavier())\n"
+        "net(mx.nd.zeros((2, 8)))  # materialize (per-rank different!)\n"
+        "tr = gluon.Trainer(net.collect_params(), 'sgd',\n"
+        "                   {'learning_rate': 0.1}, kvstore=kv,\n"
+        "                   update_on_kvstore=False)\n"
+        "loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()\n"
+        "for step in range(5):\n"
+        "    i = step * 40\n"
+        "    d = mx.nd.array(X[i:i+40]); l = mx.nd.array(y[i:i+40])\n"
+        "    with autograd.record():\n"
+        "        loss = loss_fn(net(d), l)\n"
+        "    loss.backward()\n"
+        "    tr.step(40)\n"
+        "w = net.weight.data().asnumpy()\n"
+        "print('DIGEST %.8f' % float(np.abs(w).sum()))\n"
+        "print('GLUON DIST', rank, 'OK')\n")
+    env = dict(os.environ, PYTHONPATH=os.path.join(TOOLS, ".."))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", "2",
+         "--port", "9447", "--", sys.executable, str(worker)],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert r.stdout.count("OK") == 2
+    digests = [l.split()[1] for l in r.stdout.splitlines()
+               if l.startswith("DIGEST")]
+    assert len(digests) == 2 and digests[0] == digests[1], digests
