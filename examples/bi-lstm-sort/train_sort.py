@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Sort a token sequence with a bidirectional LSTM (reference
+example/bi-lstm-sort: the classic seq-in/seq-out task where each output
+position needs BOTH directions' context — position i of the sorted output
+is the i-th order statistic of the whole input).
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=3000)
+    p.add_argument("--seq-len", type=int, default=6)
+    p.add_argument("--vocab", type=int, default=20)
+    p.add_argument("--num-epochs", type=int, default=25)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--embed", type=int, default=16)
+    p.add_argument("--lr", type=float, default=5e-3)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, args.vocab, (args.num_examples, args.seq_len))
+    Y = np.sort(X, axis=1)
+    n_train = int(0.9 * args.num_examples)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Embedding(args.vocab, args.embed),
+            gluon.rnn.LSTM(args.hidden, layout="NTC", bidirectional=True),
+            gluon.nn.Dense(args.vocab, flatten=False))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.num_epochs):
+        total, nb = 0.0, 0
+        for i in range(0, n_train, args.batch_size):
+            data = mx.nd.array(X[i:i + args.batch_size].astype("f"))
+            label = mx.nd.array(Y[i:i + args.batch_size].astype("f"))
+            with autograd.record():
+                out = net(data)                      # (B, T, vocab)
+                loss = loss_fn(out.reshape((-1, args.vocab)),
+                               label.reshape((-1,)))
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += loss.mean().asscalar()
+            nb += 1
+        if epoch % 5 == 0 or epoch == args.num_epochs - 1:
+            print("epoch %d loss %.4f" % (epoch, total / nb))
+
+    correct = total_tok = 0
+    for i in range(n_train, args.num_examples, args.batch_size):
+        out = net(mx.nd.array(X[i:i + args.batch_size].astype("f")))
+        pred = out.asnumpy().argmax(-1)
+        correct += (pred == Y[i:i + args.batch_size]).sum()
+        total_tok += pred.size
+    acc = correct / float(total_tok)
+    print("token accuracy %.3f" % acc)
+    assert acc > 0.85, "bi-lstm failed to sort"
+
+
+if __name__ == "__main__":
+    main()

@@ -77,13 +77,11 @@ class Trainer:
                 self._kvstore.set_gradient_compression(self._compression_params)
             for i, param in enumerate(self._params):
                 if param._data is not None:
+                    # kv.init broadcasts rank 0's value and writes it
+                    # back into the parameter (kvstore.py), so workers
+                    # with update_on_kvstore=False don't train forever on
+                    # divergent local inits
                     self._kvstore.init(i, param.data())
-                    if self._kvstore.num_workers > 1:
-                        # pull rank 0's broadcast init into the parameter
-                        # (reference Trainer._init_kvstore pulls after
-                        # init) — without this, update_on_kvstore=False
-                        # workers train forever on divergent local inits
-                        self._kvstore.pull(i, out=param.data())
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True

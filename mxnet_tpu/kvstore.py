@@ -80,16 +80,22 @@ class KVStore:
             self._check_key(k)
             if k in self._store:
                 raise MXNetError("key %s already initialized" % str(k))
-            vv = v[0] if isinstance(v, list) else v
-            vv = vv.copy()
+            vlist = v if isinstance(v, list) else [v]
+            vv = vlist[0].copy()
             if self.num_workers > 1:
                 # reference dist kvstore init seeds the server once and
                 # every worker pulls the SAME value (kvstore_dist.h
                 # InitImpl: only rank 0's payload lands) — broadcast rank
                 # 0's value so workers start from identical params even
-                # when their local initializers drew different numbers
+                # when their local initializers drew different numbers.
+                # The broadcast is also written back into the caller's
+                # arrays, so every init path (Module, Trainer, direct
+                # kv.init) starts training from the shared value without
+                # a separate pull.
                 from .parallel import dist
                 vv = dist.broadcast_nd(vv)
+                for dst in vlist:
+                    dst[:] = vv.as_in_context(dst.context)
             self._store[k] = vv
 
     def push(self, key, value, priority=0):

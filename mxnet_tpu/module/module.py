@@ -332,12 +332,10 @@ class Module(BaseModule):
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
             for i, name in enumerate(self._param_names):
+                # kv.init broadcasts rank 0's value and writes it back
+                # into the passed array (kvstore.py), so all workers
+                # start from identical params
                 kvstore.init(i, self._exec.arg_dict[name])
-                if kvstore.num_workers > 1:
-                    # pull rank 0's broadcast init back into the training
-                    # arrays (reference _initialize_kvstore pulls after
-                    # init) so every worker starts from identical params
-                    kvstore.pull(i, out=self._exec.arg_dict[name])
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
         if not update_on_kvstore:

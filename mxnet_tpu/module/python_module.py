@@ -1,0 +1,170 @@
+"""PythonModule / PythonLossModule.
+
+Parity with reference `python/mxnet/module/python_module.py:28,240`: module
+base classes whose forward/backward are arbitrary Python, used to splice
+host-side computation (custom losses, metrics plumbing, RL environments)
+into a Module pipeline — typically inside a SequentialModule.
+
+TPU-native note: computation written here runs eagerly on the host side of
+the step (one dispatch per op); it is the escape hatch, not the fast path —
+the same role the reference's Python modules play against its C++
+executors.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from ..initializer import Uniform
+from ..ndarray import ndarray as nd
+from .base_module import BaseModule
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """Subclass and override `forward` (+ `_compute_output_shapes`) to run
+    arbitrary Python inside a module pipeline. Parameter-free by default:
+    `get_params`/`init_params`/`update` are no-ops unless overridden."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    # -- names / shapes -------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    # -- params: parameter-free by default ------------------------------
+    def get_params(self):
+        return ({}, {})
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        self.params_initialized = True
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels):
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
+
+    # -- bind ------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        names = [x[0] if isinstance(x, (list, tuple)) else x.name
+                 for x in data_shapes]
+        assert names == self._data_names, (names, self._data_names)
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        """Subclass hook: return [(name, shape), ...] for the outputs."""
+        raise NotImplementedError()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+
+class PythonLossModule(PythonModule):
+    """A pass-through module computing a custom loss in Python: forward
+    stores its input as the output; backward emits the gradient from
+    `grad_func` (or the provided closure). Reference
+    python_module.py:240."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names=data_names, label_names=label_names,
+                         output_names=[name + "_output"], logger=logger)
+        self._name = name
+        assert len(data_names) == 1
+        if grad_func is not None and not callable(grad_func):
+            raise MXNetError("grad_func must be callable")
+        self._grad_func = grad_func
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+
+    def _compute_output_shapes(self):
+        ds = self._data_shapes[0]
+        shape = ds[1] if isinstance(ds, (list, tuple)) else ds.shape
+        return [(self._name + "_output", tuple(shape))]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train:
+            if not data_batch.label:
+                raise MXNetError(
+                    "PythonLossModule got a training batch without labels "
+                    "(add take_labels=True when chaining, or supply a "
+                    "label iterator)")
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, "pyloss is a loss head"
+        assert self.for_training
+        self._backward_impl()
+
+    def _backward_impl(self):
+        """Gradient of the loss wrt scores; subclass hook (reference
+        python_module.py:328). Default uses the grad_func closure."""
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "pass grad_func or override _backward_impl")
+        grad = self._grad_func(self._scores, self._labels)
+        if not isinstance(grad, nd.NDArray):
+            grad = nd.array(grad)
+        self._scores_grad = grad
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]
+
+    def install_monitor(self, mon):
+        pass

@@ -12,7 +12,8 @@ import os
 
 import jax
 
-__all__ = ["init", "allreduce_nd", "barrier", "rank", "size"]
+__all__ = ["init", "allreduce_nd", "broadcast_nd", "barrier", "rank",
+           "size"]
 
 _initialized = False
 

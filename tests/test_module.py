@@ -192,3 +192,50 @@ def test_feedforward_legacy():
     model.fit(x, y)
     pred = model.predict(x)
     assert pred.shape == (128, 4)
+
+
+def test_python_loss_module():
+    """PythonLossModule spliced after a Module inside SequentialModule
+    (reference python_module.py pattern): custom python loss gradient
+    drives the network."""
+    import numpy as np
+
+    x, y = _synthetic_data(n=300, dim=10, classes=4, seed=3)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fcout")
+
+    def ce_grad(scores, labels):
+        s = scores.asnumpy()
+        p = np.exp(s - s.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        lab = labels.asnumpy().astype(int)
+        p[np.arange(len(lab)), lab] -= 1.0
+        return p / len(lab)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, label_names=[]))
+    seq.add(mx.mod.PythonLossModule(grad_func=ce_grad), take_labels=True,
+            auto_wiring=True)
+    train = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True,
+                              label_name="softmax_label")
+    seq.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label, inputs_need_grad=False)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for _ in range(25):
+        train.reset()
+        for batch in train:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    # accuracy via the first module's outputs
+    train.reset()
+    correct = total = 0
+    for batch in train:
+        seq.forward(batch, is_train=False)
+        out = seq.get_outputs()[0].asnumpy()
+        n = out.shape[0] - batch.pad
+        correct += (out[:n].argmax(1) == batch.label[0].asnumpy()[:n]).sum()
+        total += n
+    assert correct / total > 0.9, correct / total

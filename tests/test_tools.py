@@ -1,5 +1,6 @@
 """Tools tests: im2rec list+rec round trip, rec2idx, parse_log."""
 import os
+import re
 import subprocess
 import sys
 
@@ -189,8 +190,7 @@ def test_launch_dist_training_converges(tmp_path):
         capture_output=True, text=True, env=env, timeout=420)
     assert r.returncode == 0, r.stderr + r.stdout
     assert r.stdout.count("OK") == 2
-    digests = [l.split()[1] for l in r.stdout.splitlines()
-               if l.startswith("DIGEST")]
+    digests = re.findall(r"DIGEST ([0-9.]+)", r.stdout)
     assert len(digests) == 2 and digests[0] == digests[1], digests
 
 
@@ -239,6 +239,5 @@ def test_launch_dist_gluon_trainer_local_update(tmp_path):
         capture_output=True, text=True, env=env, timeout=420)
     assert r.returncode == 0, r.stderr + r.stdout
     assert r.stdout.count("OK") == 2
-    digests = [l.split()[1] for l in r.stdout.splitlines()
-               if l.startswith("DIGEST")]
+    digests = re.findall(r"DIGEST ([0-9.]+)", r.stdout)
     assert len(digests) == 2 and digests[0] == digests[1], digests
