@@ -5,11 +5,13 @@ Matches the reference's benchmark_score.py configuration
 ResNet-50, batch 32, 1 chip — reference scores 109 img/s on a K80).
 
 Measures DEVICE throughput: the timed iterations run inside one compiled
-program (lax.fori_loop over the hybridized forward), so the number is the
-chip's sustained rate on the workload. The reference's per-batch Python
-loop costs ~nothing on a local GPU; here the chip sits behind a network
-tunnel whose ~40 ms/call dispatch latency would otherwise dominate the
-measurement (measured: 0.7k img/s per-call vs 5k img/s on-device).
+program (lax.fori_loop over the hybridized forward) and each timed round
+chains several program invocations through a data dependency, syncing
+once with a host scalar read at the end. Rationale: the chip sits behind
+a network tunnel with ~40 ms/call dispatch latency and a
+block_until_ready that does not actually block, so per-call host timing
+measures the relay, not the chip (0.7k img/s per-call vs ~10k img/s
+sustained on-device).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """

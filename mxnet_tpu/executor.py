@@ -39,7 +39,7 @@ from .symbol.symbol import Symbol, _graph_infer
 __all__ = ["Executor"]
 
 
-def _build_eval(sym: Symbol):
+def _build_eval(sym: Symbol, ctx=None):
     """Build eval_fn(arg_vals, aux_vals, key, is_train) -> (outs, aux_updates).
 
     Pure and traceable: one call under jit compiles the entire graph.
@@ -62,6 +62,7 @@ def _build_eval(sym: Symbol):
                 continue
             op = get_op(n.op)
             params = {k: v for k, v in n.attrs.items() if k != "__attrs__"}
+            params["_ctx"] = ctx
             if op.need_train_flag:
                 params["_is_train"] = is_train
             if op.need_rng:
@@ -94,7 +95,7 @@ class Executor:
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
-        self._eval_fn = _build_eval(symbol)
+        self._eval_fn = _build_eval(symbol, ctx)
         self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
         self._grad_names = [n for n in self._arg_names
                             if grad_req.get(n, "null") != "null"]
@@ -290,6 +291,7 @@ class Executor:
                 continue
             op = get_op(n.op)
             params = {k: v for k, v in n.attrs.items() if k != "__attrs__"}
+            params["_ctx"] = self._ctx
             if op.need_train_flag:
                 params["_is_train"] = bool(is_train)
             if op.need_rng:

@@ -34,6 +34,10 @@ def invoke(op_name, inputs, params=None, out=None, name=None, ctx=None):
     if ctx is None:
         ctx = in_arrs[0].ctx if in_arrs else None
 
+    if "_ctx" not in params:
+        # ops that choose a lowering per device (fused kernels) read this;
+        # filtered out of every static-attr cache key by the _ prefix
+        params["_ctx"] = ctx
     if op.need_train_flag and "_is_train" not in params:
         params["_is_train"] = autograd.is_training()
     if op.need_rng and "_rng_key" not in params:

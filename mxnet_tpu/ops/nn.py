@@ -616,12 +616,21 @@ def _unpack_rnn_params(flat, num_layers, input_size, state_size, bidir, mode):
     return weights, biases
 
 
-def _fused_lstm_ok(h0):
+def _fused_lstm_ok(h0, ctx=None):
     """Use the Pallas fused-LSTM kernel (the cuDNN-RNN analog) when the
-    platform compiles it for real (TPU) and the per-step working set fits
-    comfortably in VMEM; otherwise lax.scan."""
+    computation actually lowers on a TPU and the per-step working set fits
+    comfortably in VMEM; otherwise lax.scan.
+
+    The platform check alone is not enough: on a TPU-attached host a
+    cpu-context model still lowers for the CPU backend, where a
+    non-interpret pallas_call fails to compile — so the op's context (the
+    device its NDArrays are committed to, plumbed via params['_ctx'])
+    must be an accelerator too."""
     from .pallas_kernels import is_tpu
     if not is_tpu():
+        return False
+    if ctx is not None and getattr(ctx, "device_type", None) \
+            in ("cpu", "cpu_pinned", "cpu_shared"):
         return False
     B, H = h0.shape
     # gates block (B x 4H) + h/c scratch + recurrent weights, f32
@@ -629,11 +638,12 @@ def _fused_lstm_ok(h0):
     return vmem <= 8 * 1024 * 1024
 
 
-def _rnn_cell_scan(mode, x_seq, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse=False):
+def _rnn_cell_scan(mode, x_seq, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h,
+                   reverse=False, ctx=None):
     """One direction of one layer. x_seq (T,B,I) -> (T,B,H)."""
     H = h0.shape[-1]
 
-    if mode == "lstm" and _fused_lstm_ok(h0):
+    if mode == "lstm" and _fused_lstm_ok(h0, ctx):
         from .pallas_kernels import fused_lstm
         xs = jnp.flip(x_seq, 0) if reverse else x_seq
         # fused_lstm casts to its f32 working precision internally and
@@ -700,7 +710,8 @@ def _rnn(params, data, parameters, state, *state_cell):
             w_i2h, w_h2h = weights[li]
             b_i2h, b_h2h = biases[li]
             ys, h_f, c_f = _rnn_cell_scan(mode, x, h0, c0, w_i2h, w_h2h,
-                                          b_i2h, b_h2h, reverse=(dr == 1))
+                                          b_i2h, b_h2h, reverse=(dr == 1),
+                                          ctx=params.get("_ctx"))
             outs.append(ys)
             h_finals.append(h_f)
             c_finals.append(c_f)

@@ -171,3 +171,16 @@ def test_vae():
              "--num-epochs", "15")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "VAE TRAINING OK" in r.stdout
+
+
+def test_bi_lstm_sort():
+    r = _run("bi-lstm-sort/train_sort.py", "--num-examples", "2000",
+             "--num-epochs", "20", timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "token accuracy" in r.stdout
+
+
+def test_nce_loss():
+    r = _run("nce-loss/train_nce.py", timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "rank-1 accuracy" in r.stdout
