@@ -35,15 +35,25 @@ class _Conv(HybridBlock):
             self._in_channels = in_channels
             self._op_name = op_name
             nd = len(kernel_size)
+            self._layout = layout
+            self._channel_last = layout and layout[-1] == "C"
             self._kwargs = {
                 "kernel": kernel_size, "stride": strides, "dilate": dilation,
                 "pad": padding, "num_filter": channels, "num_group": groups,
-                "no_bias": not use_bias}
+                "no_bias": not use_bias, "layout": layout}
             if adj is not None:
                 self._kwargs["adj"] = adj
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups) + tuple(kernel_size)
+                if self._channel_last:
+                    # channel-last (TPU fast path): weight O,spatial...,I
+                    wshape = (channels,) + tuple(kernel_size) + \
+                        (in_channels // groups,)
+                else:
+                    wshape = (channels, in_channels // groups) + tuple(kernel_size)
             else:
+                if self._channel_last:
+                    raise ValueError("channel-last layout is not supported "
+                                     "for transposed convolution")
                 wshape = (in_channels, channels // groups) + tuple(kernel_size)
             self.weight = self.params.get("weight", shape=wshape,
                                           init=weight_initializer,
@@ -60,11 +70,12 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def _infer_shapes(self, x):
-        in_c = x.shape[1]
+        in_c = x.shape[-1] if self._channel_last else x.shape[1]
         w = list(self.weight.shape)
         if self._op_name == "Convolution":
-            if w[1] == 0:
-                w[1] = in_c // self._kwargs["num_group"]
+            iaxis = len(w) - 1 if self._channel_last else 1
+            if w[iaxis] == 0:
+                w[iaxis] = in_c // self._kwargs["num_group"]
         else:
             if w[0] == 0:
                 w[0] = in_c
@@ -171,7 +182,7 @@ class Conv3DTranspose(_Conv):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, **kwargs):
+                 pool_type, count_include_pad=None, layout=None, **kwargs):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
@@ -179,6 +190,8 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -200,7 +213,7 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         super().__init__(_to_tuple(pool_size, 1),
                          _to_tuple(strides, 1) if strides is not None else None,
-                         _to_tuple(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _to_tuple(padding, 1), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -208,7 +221,7 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_to_tuple(pool_size, 2),
                          _to_tuple(strides, 2) if strides is not None else None,
-                         _to_tuple(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _to_tuple(padding, 2), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -216,7 +229,7 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_to_tuple(pool_size, 3),
                          _to_tuple(strides, 3) if strides is not None else None,
-                         _to_tuple(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _to_tuple(padding, 3), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -225,7 +238,7 @@ class AvgPool1D(_Pooling):
         super().__init__(_to_tuple(pool_size, 1),
                          _to_tuple(strides, 1) if strides is not None else None,
                          _to_tuple(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -235,7 +248,7 @@ class AvgPool2D(_Pooling):
         super().__init__(_to_tuple(pool_size, 2),
                          _to_tuple(strides, 2) if strides is not None else None,
                          _to_tuple(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -245,37 +258,37 @@ class AvgPool3D(_Pooling):
         super().__init__(_to_tuple(pool_size, 3),
                          _to_tuple(strides, 3) if strides is not None else None,
                          _to_tuple(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "max", **kwargs)
+        super().__init__((1,), None, (0,), True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "max", **kwargs)
+        super().__init__((1, 1), None, (0, 0), True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "avg", **kwargs)
+        super().__init__((1,), None, (0,), True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "avg", **kwargs)
+        super().__init__((1, 1), None, (0, 0), True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -65,6 +65,12 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _step(self, data_batch):
+        """One training step of the fit loop. Subclasses may override to
+        fuse forward+backward+update into a single compiled dispatch."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -181,8 +187,10 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                    self.forward_backward(data_batch)
+                    self.update()
+                else:
+                    self._step(data_batch)
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)

@@ -98,6 +98,7 @@ class Module(BaseModule):
         self._label_shapes = None
         self._grad_req = None
         self._monitor = None
+        self._fused_plan = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -257,6 +258,7 @@ class Module(BaseModule):
         self._grad_req = req
 
         shared_exec = shared_module._exec if shared_module is not None else None
+        self._fused_plan = None
         ctx = self._context[0]
         self._exec = Executor.simple_bind(self._symbol, ctx, grad_req=req,
                                           shared_exec=shared_exec, **shapes)
@@ -286,6 +288,7 @@ class Module(BaseModule):
         for desc in self._data_shapes + self._label_shapes:
             shapes[desc[0]] = desc[1]
         self._exec = self._exec.reshape(**shapes)
+        self._fused_plan = None
 
     # -- optimizer -------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -298,6 +301,7 @@ class Module(BaseModule):
         if self._params_dirty:
             self._sync_params_from_devices()
         self._fused = None  # re-resolve the fused applier per optimizer
+        self._fused_plan = None
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), {n: self._exec.arg_dict[n]
                                           for n in self._param_names})
@@ -354,6 +358,7 @@ class Module(BaseModule):
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
         self._fused = None  # re-resolve against the borrowed updater
+        self._fused_plan = None
         self.optimizer_initialized = True
 
     # -- compute ---------------------------------------------------------
@@ -425,6 +430,101 @@ class Module(BaseModule):
                 for i, name, grad in live:
                     self._updater(i, grad, self._exec.arg_dict[name])
 
+    def _step(self, data_batch):
+        """One-dispatch train step: forward + backward + optimizer update in
+        a SINGLE jitted XLA program (the reference needs two engine bulk
+        segments for the same work, graph_executor.cc:1377 + the kvstore
+        update; here the whole step is one device dispatch).
+
+        Falls back to forward_backward()+update() whenever the fused form
+        can't reproduce the exact semantics: kvstore in play (reduction or
+        dist), non-fusable optimizer, or grad_req 'add'."""
+        if self._fused_plan is None:
+            self._fused_plan = self._build_fused_step()
+        if self._fused_plan is False:
+            self.forward_backward(data_batch)
+            self.update()
+            return
+        from ..ndarray.ndarray import _from_data
+        live_names, indices, fused, step_fn = self._fused_plan
+        self._load_batch(data_batch)
+        exec_ = self._exec
+        arg_vals, aux_vals = exec_._gather()
+        key = exec_._next_key()
+        grad_args = {n: arg_vals[n] for n in exec_._grad_names}
+        other_args = {n: v for n, v in arg_vals.items()
+                      if n not in exec_._grad_names}
+        weights = [exec_.arg_dict[n] for n in live_names]
+        lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+        outs, aux_up, new_ws, new_states, grads = step_fn(
+            grad_args, other_args, aux_vals, key, lrs, wds, rescale,
+            state_vals)
+        for name, val in aux_up.items():
+            exec_.aux_dict[name]._data = val
+        for w, nv in zip(weights, new_ws):
+            w._data = nv
+        # keep grad_dict live so batch callbacks / get_input_grads observe
+        # the same state as the unfused path (the grads are program outputs
+        # already on device; binding them is free of copies)
+        for name, g in grads.items():
+            dst = exec_.grad_dict.get(name)
+            if dst is not None:
+                dst._data = g
+        fused.commit_states(indices, new_states)
+        exec_.outputs = [_from_data(v, exec_._ctx) for v in outs]
+        self._params_dirty = True
+
+    def _build_fused_step(self):
+        """Build (live_names, FusedApplier, jitted step) or False."""
+        if self._kvstore is not None or self._updater is None \
+                or self._monitor is not None:
+            return False
+        fused = opt.FusedApplier.resolve(self._updater)
+        if not fused:
+            return False
+        live_names = [n for n in self._param_names
+                      if self._grad_req.get(n) == "write"
+                      and self._exec.grad_dict.get(n) is not None]
+        if any(self._grad_req.get(n) not in ("null", "write")
+               for n in self._param_names):
+            return False  # grad_req 'add' needs the accumulating path
+        if not live_names:
+            return False
+        import jax
+        exec_ = self._exec
+        _, fcompute, static = fused.update_op()
+        n_outs = len(self._output_names)
+        heads = tuple([None] * n_outs)
+
+        def step(grad_args, other_args, aux_vals, key, lrs, wds, rescale,
+                 state_vals):
+            outs, aux_up, grads = exec_._fwd_bwd_impl(
+                grad_args, other_args, aux_vals, key, heads)
+            new_ws, new_states = [], []
+            out_grads = {}
+            for k, name in enumerate(live_names):
+                params = dict(static)
+                params["lr"] = lrs[k]
+                params["wd"] = wds[k]
+                params["rescale_grad"] = rescale
+                g = grads[name].astype(grad_args[name].dtype)
+                out_grads[name] = g
+                upd_outs = fcompute(params, grad_args[name], g,
+                                    *state_vals[k])
+                new_ws.append(upd_outs[0])
+                new_states.append(tuple(upd_outs[1:]))
+            # non-param grads (inputs_need_grad) surface too
+            for name, g in grads.items():
+                if name not in out_grads:
+                    out_grads[name] = g
+            return outs, aux_up, new_ws, new_states, out_grads
+
+        # donate the optimizer states (rebound after the call); params are
+        # not donated — user code may hold views of the old weight buffers
+        step_fn = jax.jit(step, donate_argnums=(7,))
+        indices = [self._param_names.index(n) for n in live_names]
+        return (live_names, indices, fused, step_fn)
+
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
         return self._exec.outputs
@@ -453,6 +553,7 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         self._monitor = mon
+        self._fused_plan = None
         mon.install(self._exec)
 
     def save_optimizer_states(self, fname):

@@ -63,6 +63,26 @@ def _conv_dn(nd):
     return ("NC" + spec, "OI" + spec, "NC" + spec)
 
 
+def _layout_spec(params, nd):
+    """Resolve the op's `layout` attr (reference convolution-inl.h) to lax
+    dimension-number specs + the channel axis.
+
+    Channel-first (NCW/NCHW/NCDHW) keeps the reference default; channel-last
+    (NWC/NHWC/NDHWC) is the TPU fast path — the feature dim lands on the
+    lane (minor) dimension so XLA tiles the conv onto the MXU without
+    relayout copies. Channel-last weights are O,spatial...,I (the reference's
+    NHWC weight layout)."""
+    spec = "DHW"[3 - nd:]
+    layout = params.get("layout") or ("NC" + spec)
+    if layout in (None, "None"):
+        layout = "NC" + spec
+    if layout == "NC" + spec:
+        return ("NC" + spec, "OI" + spec, 1)
+    if layout == "N" + spec + "C":
+        return (layout, "O" + spec + "I", nd + 1)
+    raise MXNetError("unsupported layout " + str(layout))
+
+
 @register("Convolution")
 def _convolution(params, data, weight, *bias):
     kernel = tuple(params["kernel"])
@@ -71,7 +91,9 @@ def _convolution(params, data, weight, *bias):
     dilate = _tup(params.get("dilate"), nd, 1)
     pad = _tup(params.get("pad"), nd, 0)
     groups = params.get("num_group", 1)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(nd))
+    dspec, wspec, caxis = _layout_spec(params, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (dspec, wspec, dspec))
     # no preferred_element_type: the TPU MXU accumulates bf16 convs in f32
     # natively, and forcing f32 here leaks an f32 cotangent into the conv
     # transpose rule, which rejects mixed bf16/f32 operands under grad
@@ -81,7 +103,10 @@ def _convolution(params, data, weight, *bias):
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=groups)
     if not params.get("no_bias", False) and bias:
-        out = out + bias[0].reshape((1, -1) + (1,) * nd)
+        if caxis == 1:
+            out = out + bias[0].reshape((1, -1) + (1,) * nd)
+        else:
+            out = out + bias[0]
     return (out,)
 
 
@@ -124,8 +149,11 @@ def _pooling(params, data):
     pool_type = params.get("pool_type", "max")
     global_pool = params.get("global_pool", False)
     nd = data.ndim - 2
+    _, _, caxis = _layout_spec(params, nd)
+    spatial_axes = tuple(range(2, 2 + nd)) if caxis == 1 else \
+        tuple(range(1, 1 + nd))
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = tuple(data.shape[a] for a in spatial_axes)
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -134,22 +162,28 @@ def _pooling(params, data):
         pad = _tup(params.get("pad"), nd, 0)
         from ..base import MXNetError
         for i, (k, p) in enumerate(zip(kernel, pad)):
-            if k > data.shape[2 + i] + 2 * p:
+            if k > data.shape[spatial_axes[i]] + 2 * p:
                 raise MXNetError(
                     "Pooling kernel %s exceeds padded input %s"
-                    % (kernel, data.shape[2:]))
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+                    % (kernel, tuple(data.shape[a] for a in spatial_axes)))
+
+    def _full(kern, strd, padd):
+        if caxis == 1:
+            return (1, 1) + tuple(kern), (1, 1) + tuple(strd), \
+                ((0, 0), (0, 0)) + tuple(padd)
+        return (1,) + tuple(kern) + (1,), (1,) + tuple(strd) + (1,), \
+            ((0, 0),) + tuple(padd) + ((0, 0),)
+
+    window, strides, padding = _full(kernel, stride, [(p, p) for p in pad])
     if params.get("pooling_convention", "valid") == "full" and not global_pool:
         # ceil-mode output: extend right/bottom padding as needed
         extra = []
         for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
-            in_sz = data.shape[2 + i]
+            in_sz = data.shape[spatial_axes[i]]
             out_full = int(np.ceil((in_sz + 2 * p - k) / s)) + 1
             needed = (out_full - 1) * s + k - in_sz - p
             extra.append((p, max(needed, p)))
-        padding = ((0, 0), (0, 0)) + tuple(extra)
+        _, _, padding = _full(kernel, stride, extra)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         out = lax.reduce_window(data, init, lax.max, window, strides, padding)

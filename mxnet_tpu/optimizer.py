@@ -580,9 +580,10 @@ class FusedApplier:
         return "sgd_mom_update" if self.optimizer.momentum != 0.0 \
             else "sgd_update"
 
-    def __call__(self, indices, weights, grads):
-        import jax
-        import jax.numpy as jnp
+    def prepare(self, indices, weights):
+        """Host-side bookkeeping for one fused update over `indices`:
+        create missing states, bump update counts, and return the traced
+        per-step inputs (lrs, wds, rescale, state_vals)."""
         import numpy as _np
 
         opt = self.optimizer
@@ -611,17 +612,6 @@ class FusedApplier:
         wds = _np.asarray(wds, _np.float32)
         rescale = _np.float32(opt.rescale_grad)
 
-        op_name = self._op_name()
-        op = self._get_op(op_name)
-        static = {"clip_gradient": opt.clip_gradient or -1.0}
-        if op_name == "sgd_mom_update":
-            static["momentum"] = opt.momentum
-        if op_name == "adam_update":
-            static.update(beta1=opt.beta1, beta2=opt.beta2,
-                          epsilon=opt.epsilon)
-
-        w_vals = [w._data for w in weights]
-        g_vals = [g._data for g in grads]
         state_vals = []
         for i in indices:
             s = upd.states[i]
@@ -631,13 +621,50 @@ class FusedApplier:
                 state_vals.append(tuple(x._data for x in s))
             else:
                 state_vals.append((s._data,))
+        return lrs, wds, rescale, state_vals
+
+    def update_op(self):
+        """(fcompute, static attrs) of the registered optimizer op — the
+        building block shared by __call__ and externally fused programs
+        (Module's one-dispatch train step)."""
+        opt = self.optimizer
+        op_name = self._op_name()
+        op = self._get_op(op_name)
+        static = {"clip_gradient": opt.clip_gradient or -1.0}
+        if op_name == "sgd_mom_update":
+            static["momentum"] = opt.momentum
+        if op_name == "adam_update":
+            static.update(beta1=opt.beta1, beta2=opt.beta2,
+                          epsilon=opt.epsilon)
+        return op_name, op.fcompute, static
+
+    def commit_states(self, indices, new_states):
+        """Rebind the updater's state NDArrays to the buffers a fused
+        program returned (the states were donated into it)."""
+        upd = self.updater
+        for i, ns in zip(indices, new_states):
+            s = upd.states[i]
+            if s is None:
+                continue
+            if isinstance(s, tuple):
+                for old, new in zip(s, ns):
+                    old._data = new
+            else:
+                s._data = ns[0]
+
+    def __call__(self, indices, weights, grads):
+        import jax
+
+        lrs, wds, rescale, state_vals = self.prepare(indices, weights)
+        op_name, fcompute, static = self.update_op()
+
+        w_vals = [w._data for w in weights]
+        g_vals = [g._data for g in grads]
 
         key = (op_name, tuple(static.items()),
                tuple((v.shape, str(v.dtype)) for v in w_vals))
         fn = self._jit_cache.get(key)
         if fn is None:
-            fcompute = op.fcompute
-
             def apply_all(lrs, wds, rescale, ws, gs, states):
                 new_ws, new_states = [], []
                 for k in range(len(ws)):
@@ -663,12 +690,4 @@ class FusedApplier:
                                 state_vals)
         for w, nv in zip(weights, new_ws):
             w._data = nv
-        for i, ns in zip(indices, new_states):
-            s = upd.states[i]
-            if s is None:
-                continue
-            if isinstance(s, tuple):
-                for old, new in zip(s, ns):
-                    old._data = new
-            else:
-                s._data = ns[0]
+        self.commit_states(indices, new_states)

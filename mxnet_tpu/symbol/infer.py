@@ -64,8 +64,13 @@ def _conv(params, shapes):
     nf = params["num_filter"]
     g = params.get("num_group", 1)
     kernel = tuple(params["kernel"])
+    layout = params.get("layout") or ""
     if shapes[1] is None:
-        shapes[1] = (nf, data[1] // g) + kernel
+        if layout.endswith("C") and len(layout) > 2:
+            # channel-last layouts: weight is O,spatial...,I
+            shapes[1] = (nf,) + kernel + (data[-1] // g,)
+        else:
+            shapes[1] = (nf, data[1] // g) + kernel
     if len(shapes) > 2 and shapes[2] is None:
         shapes[2] = (nf,)
     return shapes
