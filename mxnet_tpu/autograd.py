@@ -91,16 +91,24 @@ def set_training(flag):
 
 
 class Node:
-    """One recorded op on the tape (reference AGInfo, imperative.h:59-95)."""
+    """One recorded op on the tape (reference AGInfo, imperative.h:59-95).
 
-    __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "seq", "name")
+    ``fwd_fn`` (when present) is the pure JAX function the node was recorded
+    from; ``grad(..., create_graph=True)`` replays it so gradients stay
+    differentiable (the vjp closure alone hides the residuals' dependency on
+    the primals)."""
 
-    def __init__(self, vjp_fn, inputs, out_shapes, out_dtypes, name=""):
+    __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "seq",
+                 "name", "fwd_fn")
+
+    def __init__(self, vjp_fn, inputs, out_shapes, out_dtypes, name="",
+                 fwd_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs            # list[NDArray]
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
         self.name = name
+        self.fwd_fn = fwd_fn
         _STATE.node_count += 1
         self.seq = _STATE.node_count
 
@@ -243,12 +251,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Reference `autograd.grad`: return grads of heads w.r.t. variables."""
+    """Reference `autograd.grad` (autograd.py:270-291): return grads of heads
+    w.r.t. variables; with ``create_graph=True`` the returned grads are
+    themselves on the tape, so a second ``backward``/``grad`` differentiates
+    through them (higher-order gradients)."""
     from .ndarray.ndarray import NDArray
 
     if create_graph:
-        raise NotImplementedError("create_graph=True (higher order imperative "
-                                  "grad): use mx.np_grad / jax.grad composition")
+        return _grad_taped(heads, variables, head_grads,
+                           train_mode=train_mode)
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
@@ -264,6 +275,160 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             v._grad_req = greq
             v.grad = g
     return out[0] if single else out
+
+
+def _grad_taped(heads, variables, head_grads=None, train_mode=True):
+    """``grad(..., create_graph=True)``: backward sweep whose cotangent
+    computation is ITSELF recorded on the tape.
+
+    Each tape node's backward is replayed as the pure JAX function
+    ``(primals, out_cots) -> in_cots`` (via ``jax.vjp`` over the node's
+    recorded ``fwd_fn``), so the in-cotangents stay differentiable w.r.t.
+    both the incoming cotangents AND the primals (the residual dependency
+    that a captured vjp closure would hide). Cotangent accumulation runs on
+    NDArrays under ``record()`` so the adds are taped too. The original
+    tape is retained (create_graph implies retain_graph)."""
+    from .ndarray.ndarray import NDArray, _from_data
+    import jax.numpy as jnp
+    from .base import device_of
+
+    single_v = isinstance(variables, NDArray)
+    if single_v:
+        variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    var_ids = {id(v) for v in variables}
+
+    nodes = {}
+
+    def visit(node):
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.seq in nodes:
+                continue
+            nodes[n.seq] = n
+            for x in n.inputs:
+                if x._autograd_node is not None:
+                    stack.append(x._autograd_node[0])
+
+    node_cots = {}
+    leaf_cots = {}
+
+    def add_cot(arr, cot_nd):
+        if arr._autograd_node is not None:
+            node, idx = arr._autograd_node
+            store = node_cots.setdefault(node.seq,
+                                         [None] * len(node.out_shapes))
+            store[idx] = cot_nd if store[idx] is None else store[idx] + cot_nd
+        if id(arr) in var_ids or arr._requires_grad:
+            key = id(arr)
+            leaf_cots[key] = cot_nd if key not in leaf_cots \
+                else leaf_cots[key] + cot_nd
+
+    tape_dev = None
+    for h in heads:
+        tape_dev = device_of(h._data)
+        if tape_dev is not None:
+            break
+    import contextlib
+    dev_scope = jax.default_device(tape_dev) if tape_dev is not None \
+        else contextlib.nullcontext()
+
+    with dev_scope, _RecordingScope(True, train_mode):
+        any_tape = False
+        for h, hg in zip(heads, head_grads):
+            if h._autograd_node is None and not h._requires_grad \
+                    and id(h) not in var_ids:
+                continue
+            any_tape = True
+            if h._autograd_node is not None:
+                visit(h._autograd_node[0])
+            if hg is None:
+                cot = _from_data(jnp.ones(h.shape, h.dtype,
+                                          device=device_of(h._data)), h.ctx)
+            else:
+                cot = hg
+            add_cot(h, cot)
+        if not any_tape:
+            raise MXNetError(
+                "this array is not attached to any computation graph; "
+                "run operations inside autograd.record() first")
+
+        for seq in sorted(nodes, reverse=True):
+            node = nodes[seq]
+            cots = node_cots.get(seq)
+            if cots is None:
+                continue
+            if node.fwd_fn is None:
+                raise MXNetError(
+                    "create_graph=True over a node with no replayable "
+                    "forward (%s); custom autograd.Function does not "
+                    "support higher-order gradients" % (node.name,))
+            n_in = len(node.inputs)
+            out_float = [np.issubdtype(np.dtype(d), np.inexact)
+                         for d in node.out_dtypes]
+            in_float = [np.issubdtype(np.dtype(x.dtype), np.inexact)
+                        for x in node.inputs]
+            # materialize missing output cotangents as zero NDArrays
+            full = []
+            for c, s, d, isf in zip(cots, node.out_shapes, node.out_dtypes,
+                                    out_float):
+                if c is not None or not isf:
+                    full.append(c)
+                else:
+                    full.append(_from_data(
+                        jnp.zeros(s, d, device=device_of(
+                            node.inputs[0]._data) if node.inputs else None),
+                        node.inputs[0].ctx if node.inputs else None))
+            cot_nds = [c for c, isf in zip(full, out_float) if isf and
+                       c is not None]
+
+            fwd_fn = node.fwd_fn
+            shapes_dtypes = list(zip(node.out_shapes, node.out_dtypes))
+
+            def bwd_as_fn(*args, _fwd=fwd_fn, _n=n_in, _of=tuple(out_float),
+                          _sd=tuple(shapes_dtypes), _if=tuple(in_float)):
+                primals, in_cots = args[:_n], args[_n:]
+                _, vjp = jax.vjp(lambda *p: _fwd(*p), *primals)
+                filled, it = [], iter(in_cots)
+                for isf, (s, d) in zip(_of, _sd):
+                    if isf:
+                        filled.append(next(it))
+                    else:
+                        filled.append(np.zeros(s, jax.dtypes.float0))
+                out = vjp(tuple(filled))
+                return tuple(c for c, keep in zip(out, _if) if keep)
+
+            arg_nds = list(node.inputs) + cot_nds
+            vals = [a._data for a in arg_nds]
+            raw_outs, vjp2 = jax.vjp(bwd_as_fn, *vals)
+            keep_inputs = [x for x, keep in zip(node.inputs, in_float)
+                           if keep]
+            new_node = Node(lambda cts, _v=vjp2: _v(tuple(cts)),
+                            arg_nds,
+                            [o.shape for o in raw_outs],
+                            [o.dtype for o in raw_outs],
+                            name=node.name + "_backward",
+                            fwd_fn=bwd_as_fn)
+            for i, (x, rc) in enumerate(zip(keep_inputs, raw_outs)):
+                cot_nd = _from_data(rc, x.ctx)
+                cot_nd._autograd_node = (new_node, i)
+                add_cot(x, cot_nd)
+            node_cots.pop(seq, None)
+
+    out = []
+    for v in variables:
+        g = leaf_cots.get(id(v))
+        if g is None:
+            g = _from_data(jnp.zeros(v.shape, v.dtype,
+                                     device=device_of(v._data)), v.ctx)
+        out.append(g.astype(v.dtype) if g.dtype != v.dtype else g)
+    return out[0] if single_v else out
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

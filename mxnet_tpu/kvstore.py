@@ -36,11 +36,20 @@ __all__ = ["KVStore", "create"]
 
 def _ctx_group_sum(vals):
     """Sum a list of NDArrays (possibly on different devices) onto vals[0]'s
-    device. XLA issues the cross-chip copies over ICI."""
-    out = vals[0]
-    for v in vals[1:]:
-        out = out + v.as_in_context(out.context)
-    return out
+    device with a pairwise tree (reference CommDevice's tree/P2P reduce,
+    comm.h:410): O(log n) depth, and the partial sums stay spread across
+    the source devices instead of all converging on one chip. XLA issues
+    the cross-chip copies over ICI."""
+    vals = list(vals)
+    while len(vals) > 1:
+        nxt = []
+        for i in range(0, len(vals) - 1, 2):
+            a, b = vals[i], vals[i + 1]
+            nxt.append(a + b.as_in_context(a.context))
+        if len(vals) % 2:
+            nxt.append(vals[-1])
+        vals = nxt
+    return vals[0]
 
 
 class KVStore:
@@ -100,7 +109,7 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = _normalize(key, value)
-        batch = []
+        merged_list = []
         for k, vs in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -110,8 +119,11 @@ class KVStore:
                 # reference compresses after the local device reduce, before
                 # the network hop (kvstore_dist.h:201-234)
                 merged = self._gc.compress(k, merged)
-            if self.num_workers > 1:
-                merged = self._allreduce(merged)
+            merged_list.append(merged)
+        if self.num_workers > 1:
+            merged_list = self._allreduce(merged_list)
+        batch = []
+        for k, merged in zip(keys, merged_list):
             stored = self._store[k]
             if self._updater is not None:
                 batch.append((k, merged.as_in_context(stored.context),
@@ -183,10 +195,12 @@ class KVStore:
         self._gc = GradientCompression(compression_params)
 
     # -- distributed -----------------------------------------------------
-    def _allreduce(self, merged):
-        """Cross-process gradient sum (replaces ps-lite ZPush/ZPull)."""
+    def _allreduce(self, merged_list):
+        """Cross-process gradient sum for a list push — ALL keys cross the
+        wire in ONE collective dispatch (replaces ps-lite ZPush/ZPull; the
+        reference batches ZPush the same way via engine bulking)."""
         from .parallel import dist
-        return dist.allreduce_nd(merged)
+        return dist.allreduce_nds(merged_list)
 
     def barrier(self):
         if self.num_workers > 1:

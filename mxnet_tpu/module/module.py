@@ -520,8 +520,11 @@ class Module(BaseModule):
             return outs, aux_up, new_ws, new_states, out_grads
 
         # donate the optimizer states (rebound after the call); params are
-        # not donated — user code may hold views of the old weight buffers
-        step_fn = jax.jit(step, donate_argnums=(7,))
+        # not donated — user code may hold views of the old weight buffers.
+        # CPU backends don't implement donation (JAX warns per compile).
+        donate = (7,) if getattr(self._context[0], "device_type", "cpu") \
+            not in ("cpu", "cpu_pinned", "cpu_shared") else ()
+        step_fn = jax.jit(step, donate_argnums=donate)
         indices = [self._param_names.index(n) for n in live_names]
         return (live_names, indices, fused, step_fn)
 

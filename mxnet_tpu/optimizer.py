@@ -533,6 +533,16 @@ def create(name, **kwargs):
     return Optimizer.create_optimizer(name, **kwargs)
 
 
+def _on_accelerator(weights):
+    """True when the params live on a non-CPU backend (donation there is
+    real in-place reuse; on CPU it's unsupported and just warns)."""
+    try:
+        dev = next(iter(weights[0]._data.devices()))
+        return dev.platform != "cpu"
+    except Exception:
+        return False
+
+
 class FusedApplier:
     """Apply an optimizer to MANY parameters in ONE compiled dispatch.
 
@@ -682,8 +692,10 @@ class FusedApplier:
             # below, so XLA updates them in place (the reference's
             # kWriteInplace optimizer kernels). Weights are NOT donated —
             # user code may hold views of the old weight buffers, which
-            # donation would invalidate.
-            fn = jax.jit(apply_all, donate_argnums=(5,))
+            # donation would invalidate. CPU backends don't implement
+            # donation (JAX warns per compile), so gate on the device.
+            donate = (5,) if _on_accelerator(weights) else ()
+            fn = jax.jit(apply_all, donate_argnums=donate)
             self._jit_cache[key] = fn
 
         new_ws, new_states = fn(lrs, wds, rescale, w_vals, g_vals,

@@ -5,17 +5,30 @@ Workers are `jax.distributed` processes; gradient sync is an allreduce over
 all processes' devices instead of push/pull against parameter servers. Roles
 (scheduler/server) disappear — every process is a worker, rank =
 `jax.process_index()` (reference `KVStore::get_rank`, kvstore.h:326).
+
+Allreduce design (device-side): one device per process forms a global
+1-D mesh; each process contributes its local value as one shard of a
+global array, and a jitted ``sum`` over the process axis with a replicated
+output sharding makes XLA emit the all-reduce over ICI/DCN — no host
+staging, no O(P x bytes) gather (the reference's server sharding +
+`MXNET_KVSTORE_BIGARRAY_BOUND` splitting, kvstore_dist.h:151-173, solved
+the same scaling problem for the PS transport; XLA's collective handles
+chunking internally). ``allreduce_nds`` batches MANY keys into ONE
+dispatch — the analog of the reference's engine-bulked ZPush round.
 """
 from __future__ import annotations
 
 import os
 
+import numpy as np
 import jax
 
-__all__ = ["init", "allreduce_nd", "broadcast_nd", "barrier", "rank",
-           "size"]
+__all__ = ["init", "allreduce_nd", "allreduce_nds", "broadcast_nd",
+           "barrier", "rank", "size"]
 
 _initialized = False
+_PMESH = None
+_AR_JIT = {}
 
 
 def init(coordinator_address=None, num_processes=None, process_id=None):
@@ -42,29 +55,83 @@ def size():
     return jax.process_count()
 
 
+def _proc_mesh():
+    """Global 1-D mesh with ONE device per process (process order)."""
+    global _PMESH
+    if _PMESH is None:
+        from jax.sharding import Mesh
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[i] for i in sorted(per_proc)]
+        _PMESH = Mesh(np.array(devs), ("p",))
+    return _PMESH
+
+
+def allreduce_nds(nds):
+    """Sum a LIST of NDArrays across processes in ONE jitted dispatch
+    (BSP dist_sync semantics, device-side collective)."""
+    if jax.process_count() == 1 or not nds:
+        return nds
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..ndarray.ndarray import NDArray
+
+    mesh = _proc_mesh()
+    nproc = jax.process_count()
+    my_dev = mesh.devices.flat[jax.process_index()]
+    in_shard = NamedSharding(mesh, P("p"))
+    out_shard = NamedSharding(mesh, P())
+
+    globals_in = []
+    for nd in nds:
+        local = jax.device_put(jnp.asarray(nd._data)[None], my_dev)
+        g = jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(nd.shape), in_shard, [local])
+        globals_in.append(g)
+
+    key = tuple((tuple(nd.shape), str(nd.dtype)) for nd in nds)
+    fn = _AR_JIT.get(key)
+    if fn is None:
+        fn = jax.jit(lambda *gs: tuple(jnp.sum(g, axis=0) for g in gs),
+                     out_shardings=out_shard, donate_argnums=tuple(
+                         range(len(nds))))
+        _AR_JIT[key] = fn
+    outs = fn(*globals_in)
+
+    results = []
+    for nd, out in zip(nds, outs):
+        val = out.addressable_data(0)
+        dev = nd.context.jax_device() if hasattr(nd.context, "jax_device") \
+            else None
+        if dev is not None and val.devices() != {dev}:
+            val = jax.device_put(val, dev)
+        results.append(NDArray(val, ctx=nd.context))
+    return results
+
+
 def allreduce_nd(nd):
-    """Sum an NDArray across processes (BSP dist_sync semantics)."""
+    """Sum an NDArray across processes (single-key allreduce_nds)."""
     if jax.process_count() == 1:
         return nd
-    import numpy as np
-    from jax.experimental import multihost_utils
-    from ..ndarray.ndarray import NDArray
-    # allgather the host value: NDArray buffers are committed to an
-    # explicit local device, which process_allgather cannot re-shard
-    gathered = multihost_utils.process_allgather(np.asarray(nd._data))
-    return NDArray(gathered.sum(axis=0), ctx=nd.context)
+    return allreduce_nds([nd])[0]
 
 
 def broadcast_nd(nd):
     """Replicate rank 0's NDArray value to every process (reference dist
-    kvstore init semantics: only rank 0's payload seeds the server)."""
+    kvstore init semantics: only rank 0's payload seeds the server).
+    Init-time only; the hot path is allreduce_nds."""
     if jax.process_count() == 1:
         return nd
-    import numpy as np
     from jax.experimental import multihost_utils
     from ..ndarray.ndarray import NDArray
     out = multihost_utils.broadcast_one_to_all(np.asarray(nd._data))
-    return NDArray(np.asarray(out), ctx=nd.context)
+    # commit to the source's device: a host-numpy payload would silently
+    # re-commit to the default device at first use
+    val = np.asarray(out)
+    if hasattr(nd.context, "jax_device"):
+        val = jax.device_put(val, nd.context.jax_device())
+    return NDArray(val, ctx=nd.context)
 
 
 def barrier():

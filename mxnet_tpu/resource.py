@@ -76,7 +76,11 @@ class Resource:
         with self._lock:
             if self._space is None or self._space.size < nbytes:
                 if self._space is not None:
-                    _storage.free(self._space)
+                    # drop (not recycle) the outgrown block: views handed
+                    # out by earlier get_space calls may still be live, and
+                    # recycling would let storage.alloc alias them to a new
+                    # consumer
+                    _storage.direct_free(self._space)
                 self._space = _storage.alloc(nbytes, self.ctx)
             view = self._space.dptr[:nbytes].view(dtype)
         return view.reshape(shape)
@@ -158,14 +162,26 @@ class ResourceManager:
     def seed_all(self, seed_val, ctx="all"):
         """Reseed every granted RNG resource (reference
         ResourceManager::SeedRandom, called from mx.random.seed); ctx other
-        than 'all' restricts to that device's pools."""
+        than 'all' restricts to that device's pools. ctx may be a Context
+        or a raw jax.Device (both are accepted by mx.random.seed) — the
+        comparison is by resolved device, so either form scopes the reseed
+        identically."""
+        target = None
+        if ctx != "all":
+            from .random import _resolve_device
+            target = _resolve_device(ctx)
         with self._lock:
             resources = [r for pool in self._pools.values() for r in pool]
         for r in resources:
             if r.req.type == ResourceRequest.kTempSpace:
                 continue
-            if ctx != "all" and isinstance(ctx, Context) and r.ctx != ctx:
-                continue
+            if target is not None:
+                try:
+                    rdev = r.ctx.jax_device()
+                except Exception:
+                    rdev = None
+                if rdev != target:
+                    continue
             r.seed(seed_val)
 
 

@@ -156,7 +156,8 @@ int main(int argc, char **argv) {
     if (count % 1000 == 0)
       std::fprintf(stderr, "im2rec: packed %zu\n", count);
   }
-  MXTRecordIOWriterFree(w);
+  if (MXTRecordIOWriterFree(w) != 0)
+    Fail("close rec (disk full?)");  // a failed final flush means a truncated .rec
   std::printf("im2rec: wrote %zu records (%zu errors) to %s.rec\n", count,
               errors, prefix.c_str());
   return errors && !count ? 1 : 0;

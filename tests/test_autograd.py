@@ -167,3 +167,72 @@ def test_mark_variables():
         y = x * 5
     y.backward()
     assert_almost_equal(g, np.array([5.0], np.float32))
+
+
+def test_create_graph_second_order():
+    # d/dx (3x^2)^2 path: y = x^3, dy = 3x^2 (taped), z = sum(dy^2) = 9x^4,
+    # dz/dx = 36x^3
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        dy = ag.grad(y, x, create_graph=True)
+        z = (dy * dy).sum()
+    z.backward()
+    xv = np.array([1.0, 2.0, 3.0])
+    assert_almost_equal(dy, 3 * xv ** 2)
+    assert_almost_equal(x.grad, 36 * xv ** 3)
+
+
+def test_create_graph_third_order():
+    # y = e^x sin x: y' = e^x(sin+cos), y'' = 2 e^x cos,
+    # y''' = 2 e^x (cos - sin)
+    x = mx.nd.array([0.5, -1.0])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x) * mx.nd.sin(x)
+        g1 = ag.grad(y, x, create_graph=True)
+        g2 = ag.grad(g1, x, create_graph=True)
+        g3 = ag.grad(g2, x)
+    xv = np.array([0.5, -1.0])
+    assert np.allclose(g1.asnumpy(), np.exp(xv) * (np.sin(xv) + np.cos(xv)),
+                       atol=1e-5)
+    assert np.allclose(g2.asnumpy(), 2 * np.exp(xv) * np.cos(xv), atol=1e-5)
+    assert np.allclose(g3.asnumpy(), 2 * np.exp(xv) * (np.cos(xv) - np.sin(xv)),
+                       atol=1e-5)
+
+
+def test_create_graph_matches_finite_differences():
+    # gradient-penalty shape: d/dw ||d loss/d w||^2 vs central differences
+    rng = np.random.RandomState(7)
+    wv0 = rng.rand(4, 4).astype(np.float32)
+    vv = rng.rand(4, 1).astype(np.float32)
+    v = mx.nd.array(vv)
+
+    def loss_grad_at(wv):
+        wnd = mx.nd.array(wv)
+        wnd.attach_grad()
+        with ag.record():
+            l = mx.nd.tanh(mx.nd.dot(wnd, v)).sum()
+        l.backward()
+        return wnd.grad.asnumpy()
+
+    w = mx.nd.array(wv0)
+    w.attach_grad()
+    with ag.record():
+        loss = mx.nd.tanh(mx.nd.dot(w, v)).sum()
+        gw = ag.grad(loss, w, create_graph=True)
+        gnorm = (gw * gw).sum()
+    gnorm.backward()
+    analytic = w.grad.asnumpy()
+    eps = 1e-3
+    num = np.zeros_like(analytic)
+    for i in range(4):
+        for j in range(4):
+            wp = wv0.copy()
+            wp[i, j] += eps
+            wm = wv0.copy()
+            wm[i, j] -= eps
+            num[i, j] = ((loss_grad_at(wp) ** 2).sum()
+                         - (loss_grad_at(wm) ** 2).sum()) / (2 * eps)
+    assert np.abs(analytic - num).max() < 1e-2

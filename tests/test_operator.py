@@ -413,3 +413,37 @@ def test_linalg_namespaces():
     # deliberate delta vs reference: scalar () instead of (1,) — the
     # jnp.sum over the diagonal drops the axis (la_op.h keeps a 1-dim)
     assert o == [()]
+
+
+def test_space_to_depth_conv_rewrite_matches_direct():
+    """The TPU stem rewrite (_space_to_depth_conv) must be the EXACT same
+    function as the stride-2 conv it replaces, gradients included."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.ops.nn import _space_to_depth_conv
+
+    rng = np.random.RandomState(0)
+    for (C, k, pad, H) in [(3, 7, 3, 32), (1, 3, 1, 28), (4, 5, 2, 63),
+                           (3, 8, 3, 64)]:
+        x = jnp.asarray(rng.randn(2, C, H, H).astype(np.float32))
+        w = jnp.asarray(rng.randn(8, C, k, k).astype(np.float32))
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+
+        def f_ref(x, w):
+            return lax.conv_general_dilated(
+                x, w, (2, 2), [(pad, pad), (pad, pad)],
+                dimension_numbers=dn).sum()
+
+        def f_got(x, w):
+            return _space_to_depth_conv(x, w, (pad, pad)).sum()
+
+        ref = lax.conv_general_dilated(x, w, (2, 2), [(pad, pad), (pad, pad)],
+                                       dimension_numbers=dn)
+        got = _space_to_depth_conv(x, w, (pad, pad))
+        assert ref.shape == got.shape
+        assert float(jnp.abs(ref - got).max()) < 1e-4
+        for a, b in zip(jax.grad(f_ref, (0, 1))(x, w),
+                        jax.grad(f_got, (0, 1))(x, w)):
+            assert float(jnp.abs(a - b).max()) < 1e-3

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Ablate full ResNet-50 bf16 bs128 train throughput on the chip.
+
+Variants:
+  base      — NCHW, BN stats in f32 (matches framework path; sanity vs
+              examples/image-classification/benchmark.py)
+  bnbf16    — BN stats computed in bf16
+  s2d       — space-to-depth stem: 7x7s2 conv on 3 channels replaced by an
+              equivalent 4x4 conv on a (N,56,56,48) space-to-depth input
+              (the MLPerf-TPU trick: packs the 3-channel stem onto the MXU)
+  fwdonly   — inference forward only (locates fwd:bwd split)
+
+Sync discipline: K steps in fori_loop, calls chained through the carry,
+one scalar read at the end (bench.py rationale).
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LAYERS = [3, 4, 6, 3]
+CMID = [64, 128, 256, 512]
+COUT = [256, 512, 1024, 2048]
+
+
+def build(variant):
+    bn_f32 = variant not in ("bnbf16",)
+    bn_mixed = variant in ("bnmixed", "combo")
+    s2d = variant in ("s2d", "combo")
+    rng = np.random.RandomState(0)
+
+    def mk(shape):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.05,
+                           jnp.bfloat16)
+
+    params = []
+
+    def add_conv(k, cin, cout):
+        params.append(mk((cout, cin, k, k)))
+        params.append(jnp.ones((cout,), jnp.bfloat16))
+        params.append(jnp.zeros((cout,), jnp.bfloat16))
+        return len(params) - 3
+
+    def conv(x, w, stride=1):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        p = (w.shape[2] - 1) // 2
+        return lax.conv_general_dilated(x, w, (stride, stride),
+                                        [(p, p), (p, p)],
+                                        dimension_numbers=dn)
+
+    def bn(x, g, b, relu=True):
+        if bn_mixed:
+            # stats accumulate in f32 (cast fuses into the reductions,
+            # no f32 copy of x materializes); elementwise stays bf16 as a
+            # single scale/shift multiply-add
+            m = jnp.mean(x, (0, 2, 3), dtype=jnp.float32)
+            m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), (0, 2, 3))
+            v = m2 - m * m
+            scale = g.astype(jnp.float32) * lax.rsqrt(v + 1e-5)
+            shift = b.astype(jnp.float32) - m * scale
+            y = x * scale.astype(x.dtype).reshape(1, -1, 1, 1) \
+                + shift.astype(x.dtype).reshape(1, -1, 1, 1)
+        else:
+            x32 = x.astype(jnp.float32) if bn_f32 else x
+            m = jnp.mean(x32, (0, 2, 3))
+            v = jnp.var(x32, (0, 2, 3))
+            y = (x32 - m.reshape(1, -1, 1, 1)) * lax.rsqrt(
+                v.reshape(1, -1, 1, 1) + 1e-5)
+            y = y.astype(x.dtype) * g.reshape(1, -1, 1, 1) \
+                + b.reshape(1, -1, 1, 1)
+        return jax.nn.relu(y) if relu else y
+
+    if s2d:
+        stem = add_conv(4, 48, 64)  # 4x4 on space-to-depth(4) input, stride 1
+    else:
+        stem = add_conv(7, 3, 64)
+    blocks = []
+    cin = 64
+    for st in range(4):
+        stage = []
+        for i in range(LAYERS[st]):
+            stride = (1 if st == 0 else 2) if i == 0 else 1
+            blk = dict(c1=add_conv(1, cin, CMID[st]),
+                       c2=add_conv(3, CMID[st], CMID[st]),
+                       c3=add_conv(1, CMID[st], COUT[st]),
+                       proj=add_conv(1, cin, COUT[st]) if i == 0 else None,
+                       stride=stride)
+            stage.append(blk)
+            cin = COUT[st]
+        blocks.append(stage)
+    params.append(mk((2048, 1000)))
+
+    def ap(x, idx, stride=1, relu=True, pv=None):
+        return bn(conv(x, pv[idx], stride), pv[idx + 1], pv[idx + 2],
+                  relu=relu)
+
+    def forward(pv, x):
+        if s2d:
+            # (N,3,224,224) -> (N,48,56,56): 4x4 blocks into channels
+            n = x.shape[0]
+            x = x.reshape(n, 3, 56, 4, 56, 4).transpose(0, 1, 3, 5, 2, 4)
+            x = x.reshape(n, 48, 56, 56)
+            y = ap(x, stem, stride=1, pv=pv)
+        else:
+            y = ap(x, stem, stride=2, pv=pv)
+            y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 1, 3, 3),
+                                  (1, 1, 2, 2),
+                                  ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for stage in blocks:
+            for b in stage:
+                sc = y if b["proj"] is None else \
+                    ap(y, b["proj"], stride=b["stride"], relu=False, pv=pv)
+                z = ap(y, b["c1"], pv=pv)
+                z = ap(z, b["c2"], stride=b["stride"], pv=pv)
+                z = ap(z, b["c3"], relu=False, pv=pv)
+                y = jax.nn.relu(z + sc)
+        y = jnp.mean(y.astype(jnp.float32), (2, 3)).astype(y.dtype)
+        return jnp.dot(y, pv[-1])
+
+    return params, forward
+
+
+def run(variant, batch=128, k=10, calls=3):
+    params, forward = build(variant)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.float32),
+                    jnp.bfloat16)
+    yl = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
+
+    if variant == "fwdonly":
+        @jax.jit
+        def loop(pv, xv, acc0):
+            def body(i, acc):
+                xi = jnp.roll(xv, i, axis=0)
+                return acc + forward(pv, xi).astype(jnp.float32).sum()
+            return lax.fori_loop(0, k, body, acc0)
+
+        t0 = time.time()
+        float(loop(params, x, jnp.float32(0)))
+        print("%s: compiled %.1fs" % (variant, time.time() - t0), flush=True)
+        t0 = time.time()
+        acc = jnp.float32(0)
+        for _ in range(calls):
+            acc = loop(params, x, acc)
+        float(acc)
+        dt = time.time() - t0
+        print("%s: %.1f img/s" % (variant, calls * k * batch / dt), flush=True)
+        return
+
+    def loss_fn(pv, xv, yv):
+        logits = forward(pv, xv).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, yv[:, None], 1))
+
+    @jax.jit
+    def k_steps(pv, sv, xv, yv):
+        def body(i, carry):
+            pv, sv, _ = carry
+            xi = jnp.roll(xv, i, axis=0)
+            loss, g = jax.value_and_grad(loss_fn)(pv, xi, yv)
+            sv = [0.9 * s + gg.astype(s.dtype) for s, gg in zip(sv, g)]
+            pv = [p - 0.05 * s.astype(p.dtype) for p, s in zip(pv, sv)]
+            return pv, sv, loss
+        return lax.fori_loop(0, k, body, (pv, sv, jnp.float32(0)))
+
+    momenta = [jnp.zeros_like(p) for p in params]
+    t0 = time.time()
+    params, momenta, loss = k_steps(params, momenta, x, yl)
+    float(loss)
+    print("%s: compiled %.1fs" % (variant, time.time() - t0), flush=True)
+    t0 = time.time()
+    for _ in range(calls):
+        params, momenta, loss = k_steps(params, momenta, x, yl)
+    float(loss)
+    dt = time.time() - t0
+    print("%s: %.1f img/s (train bf16 bs%d)"
+          % (variant, calls * k * batch / dt, batch), flush=True)
+
+
+if __name__ == "__main__":
+    variants = sys.argv[1:] or ["base", "fwdonly", "bnbf16", "s2d"]
+    print(jax.devices(), flush=True)
+    for v in variants:
+        run(v)
