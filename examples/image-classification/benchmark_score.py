@@ -31,12 +31,15 @@ NETS = {
 }
 
 
-def score(network, batch_size, ctx, image=224, iters=20):
+def score(network, batch_size, ctx, image=224, iters=20, dtype="float32"):
     net = NETS[network]()
     net.initialize(ctx=ctx)
     net.hybridize()
     size = 299 if network == "inception_v3" else image
-    x = mx.nd.random.uniform(shape=(batch_size, 3, size, size), ctx=ctx)
+    x = mx.nd.random.uniform(shape=(batch_size, 3, size, size),
+                             ctx=ctx).astype(dtype)
+    if dtype != "float32":
+        net.cast(dtype)
     net(x).asnumpy()  # compile
     t0 = time.time()
     out = None
@@ -55,13 +58,18 @@ def main():
     parser.add_argument("--batch-sizes", nargs="+", type=int, default=[32])
     parser.add_argument("--ctx", default="tpu", choices=["cpu", "tpu"])
     parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="bfloat16 is the MXU-native inference dtype")
     args = parser.parse_args()
     ctx = mx.tpu() if args.ctx == "tpu" and mx.context.num_tpus() \
         else mx.cpu()
     for network in args.networks:
         for b in args.batch_sizes:
-            img_s = score(network, b, ctx, iters=args.iters)
-            print("network: %s, batch %d: %.1f img/s" % (network, b, img_s))
+            img_s = score(network, b, ctx, iters=args.iters,
+                          dtype=args.dtype)
+            print("network: %s, dtype %s, batch %d: %.1f img/s"
+                  % (network, args.dtype, b, img_s))
 
 
 if __name__ == "__main__":

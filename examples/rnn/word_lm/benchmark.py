@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""LSTM-PTB training-throughput benchmark in tokens/s (the driver's second
+metric, BASELINE.json LSTM-PTB; reference example/rnn/word_lm/train.py).
+
+Medium PTB config by default (vocab 10k, 2x650 LSTM, seq 35, batch 32 —
+the classic Zaremba et al. setup the reference's word_lm example trains).
+The fused RNN op dispatches to the Pallas fused-LSTM kernel on TPU, with
+the Pallas backward for training.
+
+Measurement discipline matches examples/image-classification/benchmark.py:
+K steps chained in one fori_loop dispatch, calls chained through the params
+carry, one scalar read at the end (bench.py sync rationale).
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--vocab", type=int, default=10000)
+    p.add_argument("--num-hidden", type=int, default=650)
+    p.add_argument("--num-embed", type=int, default=650)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--seq-len", type=int, default=35)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--steps-per-call", type=int, default=20)
+    p.add_argument("--num-calls", type=int, default=4)
+    p.add_argument("--lr", type=float, default=1.0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn, rnn
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    T, B, V = args.seq_len, args.batch_size, args.vocab
+
+    class PTBModel(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.embed = nn.Embedding(V, args.num_embed)
+                self.lstm = rnn.LSTM(args.num_hidden,
+                                     num_layers=args.num_layers,
+                                     layout="TNC",
+                                     input_size=args.num_embed)
+                self.decoder = nn.Dense(V, flatten=False,
+                                        in_units=args.num_hidden)
+
+        def hybrid_forward(self, F, x):
+            e = self.embed._forward_impl(x)
+            h = self.lstm._forward_impl(e)
+            return self.decoder._forward_impl(h)
+
+    net = PTBModel()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randint(0, V, (T, B)).astype(np.int32)
+    y_np = rng.randint(0, V, (T, B)).astype(np.int32)
+    x0 = mx.nd.array(x_np, ctx=ctx, dtype="int32")
+    net(x0)  # materialize params + build the cached jit
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    names = net._param_order
+    params_nd = net.collect_params()
+    params = tuple(params_nd[n].data()._data.astype(dtype)
+                   if jnp.issubdtype(params_nd[n].data()._data.dtype,
+                                     jnp.floating) else
+                   params_nd[n].data()._data for n in names)
+    cached = net._cached_jit
+    key = jax.random.PRNGKey(0)
+
+    dev = ctx.jax_device()
+    xb = jax.device_put(jnp.asarray(x_np), dev)
+    yb = jax.device_put(jnp.asarray(y_np), dev)
+
+    def loss_fn(pv, xv, yv):
+        logits = cached(pv, key, True, xv)[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp.reshape(-1, V), yv.reshape(-1)[:, None], 1))
+
+    k = args.steps_per_call
+    lr = args.lr
+
+    @jax.jit
+    def k_steps(pv, xv, yv):
+        def body(i, carry):
+            pv, _ = carry
+            xi = jnp.roll(xv, i, axis=1)
+            loss, g = jax.value_and_grad(loss_fn)(pv, xi, yv)
+            pv = tuple(p - lr * gg.astype(p.dtype) if gg is not None else p
+                       for p, gg in zip(pv, g))
+            return pv, loss
+        return lax.fori_loop(0, k, body, (pv, jnp.float32(0)))
+
+    print("compiling %d-step LSTM train program..." % k, flush=True)
+    t0 = time.time()
+    params, loss = k_steps(params, xb, yb)
+    float(loss)
+    compile_s = time.time() - t0
+    print("compiled in %.1fs" % compile_s, flush=True)
+
+    calls = max(1, args.num_calls)
+    t0 = time.time()
+    for _ in range(calls):
+        params, loss = k_steps(params, xb, yb)
+    lv = float(loss)
+    dt = time.time() - t0
+    rate = calls * k * B * T / dt
+    print("final loss %.4f" % lv, flush=True)
+    print("PTB LSTM %dx%d vocab %d dtype %s batch %d seq %d: "
+          "%.0f tokens/s train (compile %.1fs)"
+          % (args.num_layers, args.num_hidden, V, args.dtype, B, T,
+             rate, compile_s))
+
+
+if __name__ == "__main__":
+    main()

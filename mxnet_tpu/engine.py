@@ -24,9 +24,10 @@ import os
 
 import jax
 
-__all__ = ["waitall", "is_naive", "set_engine_type"]
+__all__ = ["waitall", "is_naive", "set_engine_type", "fence"]
 
 _ENGINE_TYPE = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+_FENCE_JIT = {}
 
 
 def set_engine_type(name):
@@ -39,24 +40,68 @@ def is_naive():
     return _ENGINE_TYPE == "NaiveEngine"
 
 
+def _needs_readback(arr):
+    """On relayed PJRT backends (the axon TPU tunnel) ``block_until_ready``
+    is a fast-path no-op; the only barrier that provably waits is READING a
+    result derived from the buffer (see bench.py). CPU blocks properly."""
+    try:
+        return any(d.platform != "cpu" for d in arr.devices())
+    except Exception:
+        return False
+
+
+def fence(arrs):
+    """Provably wait for every array in ``arrs``: block_until_ready, plus —
+    for accelerator buffers — ONE jitted scalar reduction whose value
+    depends on every buffer, read back to the host. One ~90ms readback per
+    device fences any number of arrays."""
+    import numpy as np
+    by_dev = {}
+    for a in arrs:
+        try:
+            a.block_until_ready()
+        except Exception:
+            continue  # deleted buffers between listing and wait are fine
+        if _needs_readback(a):
+            dev = next(iter(a.devices()))
+            by_dev.setdefault(dev, []).append(a)
+    for dev, group in by_dev.items():
+        key = (dev, tuple((tuple(a.shape), str(a.dtype)) for a in group))
+        fn = _FENCE_JIT.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+
+            def _scalar_probe(*xs):
+                # a REAL data dependency on each buffer (a *0 product would
+                # constant-fold away and XLA would skip the reads)
+                acc = jnp.float32(0)
+                for x in xs:
+                    if x.size:
+                        acc = acc + jax.lax.convert_element_type(
+                            x.ravel()[0], jnp.float32)
+                return acc
+            fn = jax.jit(_scalar_probe)
+            _FENCE_JIT[key] = fn
+        # device errors surface at this read — the reference rethrows async
+        # exceptions at WaitForVar/WaitForAll the same way
+        float(np.asarray(fn(*group)))
+
+
 def waitall():
     """Block until all dispatched work is complete (Engine::WaitForAll)."""
     try:
         arrs = jax.live_arrays()
     except Exception:  # pragma: no cover
         arrs = []
-    for a in arrs:
-        try:
-            a.block_until_ready()
-        except Exception:
-            # deleted buffers between listing and wait are fine
-            pass
+    fence(arrs)
 
 
 def maybe_sync(value):
     """NaiveEngine mode: force completion of a freshly dispatched op."""
     if is_naive():
         jax.block_until_ready(value)
+        if _needs_readback(value):
+            fence([value])
     return value
 
 

@@ -91,7 +91,8 @@ class NDArray:
     # ------------------------------------------------------------------
     def wait_to_read(self):
         if not _is_tracer(self._data):
-            jax.block_until_ready(self._data)
+            from .. import engine
+            engine.fence([self._data])
 
     wait_to_write = wait_to_read
 
@@ -195,7 +196,15 @@ class NDArray:
             if isinstance(value, np.ndarray):
                 self._data = jax.device_put(value, device_of(self._data))
             else:
-                self._data = jnp.asarray(value, self.dtype)
+                # a device-array source must land on SELF's device — binding
+                # the source buffer directly would silently migrate this
+                # array to the source's device (caught by the TPU lane:
+                # Module._load_batch feeding a cpu batch into a tpu executor)
+                new = jnp.asarray(value, self.dtype)
+                dev = device_of(self._data)
+                if dev is not None and device_of(new) not in (None, dev):
+                    new = jax.device_put(new, dev)
+                self._data = new
         else:
             self._data = self._data.at[key].set(value.astype(self.dtype)
                                                 if hasattr(value, "astype") else value)

@@ -344,19 +344,216 @@ def fused_lstm(x, h0, c0, wx, wh, b):
     return hseq.astype(x.dtype), hn.astype(x.dtype), cn.astype(x.dtype)
 
 
+def _lstm_fwd_train_kernel(xp_ref, wh_ref, h0_ref, c0_ref,
+                           hseq_ref, cseq_ref, gates_ref, hn_ref, cn_ref,
+                           h_scr, c_scr, *, hidden):
+    """Forward that ALSO saves the per-step cell states and post-activation
+    gates — the residuals the fused backward consumes."""
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h = h_scr[:]
+    gates = xp_ref[0] + jnp.dot(h, wh_ref[:],
+                                preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gates[:, :hidden])
+    f = jax.nn.sigmoid(gates[:, hidden:2 * hidden])
+    g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+    o = jax.nn.sigmoid(gates[:, 3 * hidden:])
+    c = f * c_scr[:] + i * g
+    h = o * jnp.tanh(c)
+    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=1)
+    h_scr[:] = h
+    c_scr[:] = c
+    hseq_ref[0] = h
+    cseq_ref[0] = c
+
+    @pl.when(t == nt - 1)
+    def _():
+        hn_ref[:] = h
+        cn_ref[:] = c
+
+
+def _lstm_bwd_kernel(dh_seq_ref, gates_ref, cseq_ref, cprev_ref, whT_ref,
+                     dhn_ref, dcn_ref, dgates_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, *, hidden):
+    """Reverse-time recurrence of the LSTM backward. The grid walks t from
+    T-1 down to 0 (reverse index maps); dh/dc carries live in VMEM scratch.
+    Weight/input gradients are big sequence-wide matmuls computed OUTSIDE
+    on the MXU from the dgates this kernel emits."""
+    tr = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(tr == 0)
+    def _():
+        dh_scr[:] = dhn_ref[:]
+        dc_scr[:] = dcn_ref[:]
+
+    dh = dh_seq_ref[0] + dh_scr[:]
+    i = gates_ref[0][:, :hidden]
+    f = gates_ref[0][:, hidden:2 * hidden]
+    g = gates_ref[0][:, 2 * hidden:3 * hidden]
+    o = gates_ref[0][:, 3 * hidden:]
+    c_t = cseq_ref[0]
+    c_prev = cprev_ref[0]
+    tanh_ct = jnp.tanh(c_t)
+    do = dh * tanh_ct
+    dc = dc_scr[:] + dh * o * (1.0 - tanh_ct * tanh_ct)
+    di = dc * g
+    df = dc * c_prev
+    dg = dc * i
+    dgates = jnp.concatenate([
+        di * i * (1.0 - i),
+        df * f * (1.0 - f),
+        dg * (1.0 - g * g),
+        do * o * (1.0 - o)], axis=1)
+    dgates_ref[0] = dgates
+    dh_scr[:] = jnp.dot(dgates, whT_ref[:],
+                        preferred_element_type=jnp.float32)
+    dc_scr[:] = dc * f
+
+    @pl.when(tr == nt - 1)
+    def _():
+        dh0_ref[:] = dh_scr[:]
+        dc0_ref[:] = dc_scr[:]
+
+
+def _lstm_bwd_fits_vmem(bs, hidden):
+    # per-step residency: 4 seq blocks (B x {H,H,H,4H}) + whT (4H x H)
+    # + dgates out (B x 4H) + dh/dc scratch, all f32
+    vmem = (bs * hidden * 3 + bs * 4 * hidden * 2
+            + 4 * hidden * hidden + 2 * bs * hidden) * 4
+    return vmem <= 10 * 1024 * 1024
+
+
 def _lstm_vjp_fwd(x, h0, c0, wx, wh, b):
-    return fused_lstm(x, h0, c0, wx, wh, b), (x, h0, c0, wx, wh, b)
+    t, bs, _ = x.shape
+    hidden = wh.shape[0]
+    if not _lstm_bwd_fits_vmem(bs, hidden):
+        # large-H fallback: inference kernel forward, scan-vjp backward
+        return fused_lstm(x, h0, c0, wx, wh, b), (x, h0, c0, wx, wh, b, None)
+    xp = (jnp.einsum("tbi,ih->tbh", _cast(x, jnp.float32),
+                     _cast(wx, jnp.float32),
+                     preferred_element_type=jnp.float32)
+          + b.astype(jnp.float32))
+    kern = functools.partial(_lstm_fwd_train_kernel, hidden=hidden)
+    hseq, cseq, gates, hn, cn = pl.pallas_call(
+        kern,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bs, 4 * hidden), lambda i: (i, i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, 4 * hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bs, hidden), lambda i: (i, i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bs, hidden), lambda i: (i, i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bs, 4 * hidden), lambda i: (i, i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), lambda i: (i * 0, i * 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, bs, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((t, bs, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((t, bs, 4 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((bs, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((bs, hidden), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bs, hidden), jnp.float32),
+            pltpu.VMEM((bs, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, _cast(wh, jnp.float32), _cast(h0, jnp.float32),
+      _cast(c0, jnp.float32))
+    outs = (hseq.astype(x.dtype), hn.astype(x.dtype), cn.astype(x.dtype))
+    return outs, (x, h0, c0, wx, wh, b, (hseq, cseq, gates))
 
 
 def _lstm_vjp_bwd(res, g):
-    # backward recomputes the sequence with the scan reference (same math,
-    # differentiable); a fused pallas backward is a later optimisation.
-    # Compute in f32 (the kernel's accumulation dtype; also f64 inputs are
-    # legal at the NDArray layer but not on the MXU) and cast grads back.
-    res32 = tuple(_cast(r, jnp.float32) for r in res)
-    g32 = tuple(_cast(t, jnp.float32) for t in g)
-    _, vjp = jax.vjp(_lstm_scan_ref, *res32)
-    return tuple(_cast(gr, r.dtype) for gr, r in zip(vjp(g32), res))
+    x, h0, c0, wx, wh, b, saved = res
+    if saved is None:
+        # scan-reference fallback (same math, differentiable). f32: the
+        # kernel's accumulation dtype; f64 inputs are legal at the NDArray
+        # layer but not on the MXU.
+        res6 = (x, h0, c0, wx, wh, b)
+        res32 = tuple(_cast(r, jnp.float32) for r in res6)
+        g32 = tuple(_cast(t_, jnp.float32) for t_ in g)
+        _, vjp = jax.vjp(_lstm_scan_ref, *res32)
+        return tuple(_cast(gr, r.dtype) for gr, r in zip(vjp(g32), res6))
+
+    hseq, cseq, gates = saved
+    t, bs, _ = x.shape
+    hidden = wh.shape[0]
+    dhseq, dhn, dcn = (_cast(t_, jnp.float32) for t_ in g)
+    x32 = _cast(x, jnp.float32)
+    h0_32 = _cast(h0, jnp.float32)
+    c0_32 = _cast(c0, jnp.float32)
+    cprev = jnp.concatenate([c0_32[None], cseq[:-1]], axis=0)
+    hprev = jnp.concatenate([h0_32[None], hseq[:-1]], axis=0)
+    whT = jnp.swapaxes(_cast(wh, jnp.float32), 0, 1)
+
+    kern = functools.partial(_lstm_bwd_kernel, hidden=hidden)
+    rev3 = lambda i: (t - 1 - i, i * 0, i * 0)
+    rep2 = lambda i: (i * 0, i * 0)
+    dgates, dh0, dc0 = pl.pallas_call(
+        kern,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bs, hidden), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bs, 4 * hidden), rev3,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bs, hidden), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bs, hidden), rev3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((4 * hidden, hidden), rep2,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), rep2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), rep2, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bs, 4 * hidden), rev3,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), rep2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, hidden), rep2, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, bs, 4 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((bs, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((bs, hidden), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bs, hidden), jnp.float32),
+            pltpu.VMEM((bs, hidden), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(dhseq, gates, cseq, cprev, whT, dhn, dcn)
+
+    # sequence-wide weight/input grads: three big MXU matmuls
+    wx32 = _cast(wx, jnp.float32)
+    dx = jnp.einsum("tbh,ih->tbi", dgates, wx32,
+                    preferred_element_type=jnp.float32)
+    dwx = jnp.einsum("tbi,tbh->ih", x32, dgates,
+                     preferred_element_type=jnp.float32)
+    dwh = jnp.einsum("tbi,tbh->ih", hprev, dgates,
+                     preferred_element_type=jnp.float32)
+    db = jnp.sum(dgates, axis=(0, 1))
+    grads = (dx, dh0, dc0, dwx, dwh, db)
+    return tuple(_cast(gr, r.dtype)
+                 for gr, r in zip(grads, (x, h0, c0, wx, wh, b)))
 
 
 fused_lstm.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)

@@ -6,27 +6,57 @@ use --xla_force_host_platform_device_count=8 host devices.
 
 Must run before jax initializes any backend: forces the cpu platform and
 drops the axon TPU plugin registration (tests never touch the real chip).
+
+On-TPU lane (the reference's GPU re-run pattern,
+tests/python/gpu/test_operator_gpu.py): set ``MXNET_TEST_TPU=1`` to keep
+the real accelerator visible and run the ``tpu``-marked smoke tests:
+
+    MXNET_TEST_TPU=1 python -m pytest tests/ -m tpu -q
+
+Without the env var, ``tpu``-marked tests are skipped and everything else
+runs on the virtual CPU mesh as before. The TPU lane assumes sole ownership
+of the (single-client) chip — stop other TPU processes first.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+TPU_LANE = os.environ.get("MXNET_TEST_TPU", "") == "1"
 
-try:
-    # sitecustomize may have imported jax already (axon TPU plugin), so the
-    # env var alone is too late — update the live config before any backend
-    # initializes.
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+if not TPU_LANE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    try:
+        # sitecustomize may have imported jax already (axon TPU plugin), so
+        # the env var alone is too late — update the live config before any
+        # backend initializes.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
 
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: smoke tests that run on the real TPU chip "
+        "(enabled with MXNET_TEST_TPU=1, select with -m tpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if TPU_LANE:
+        return
+    skip_tpu = pytest.mark.skip(
+        reason="real-TPU lane disabled (set MXNET_TEST_TPU=1 and run -m tpu)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)
 
 
 @pytest.fixture(autouse=True)
