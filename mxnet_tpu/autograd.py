@@ -99,12 +99,22 @@ class Node:
     the primals)."""
 
     __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "seq",
-                 "name", "fwd_fn")
+                 "name", "fwd_fn", "in_vals")
 
     def __init__(self, vjp_fn, inputs, out_shapes, out_dtypes, name="",
-                 fwd_fn=None):
+                 fwd_fn=None, in_vals=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs            # list[NDArray]
+        # snapshot the (immutable) jax buffers at record time: in-place
+        # NDArray mutation rebinds ._data, so replay for create_graph must
+        # not read the inputs' *current* buffers (they may have moved on).
+        # Only replayable nodes need it (fwd_fn-less custom Functions
+        # reject create_graph anyway; don't pin their buffers).
+        if fwd_fn is None:
+            self.in_vals = None
+        else:
+            self.in_vals = [a._data for a in inputs] if in_vals is None \
+                else list(in_vals)
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
         self.name = name
@@ -405,16 +415,30 @@ def _grad_taped(heads, variables, head_grads=None, train_mode=True):
                 return tuple(c for c, keep in zip(out, _if) if keep)
 
             arg_nds = list(node.inputs) + cot_nds
-            vals = [a._data for a in arg_nds]
+            # inputs use the record-time snapshot (ADVICE r2: current ._data
+            # may have been rebound by in-place mutation since recording)
+            vals = list(node.in_vals) + [c._data for c in cot_nds]
+            for v in vals:
+                if getattr(v, "is_deleted", lambda: False)():
+                    raise MXNetError(
+                        "create_graph replay over node %s: a recorded input "
+                        "buffer was donated/deleted (e.g. by a fused "
+                        "optimizer step) after recording; higher-order "
+                        "gradients must be taken before in-place donation "
+                        "of the tape's inputs" % (node.name,))
             raw_outs, vjp2 = jax.vjp(bwd_as_fn, *vals)
             keep_inputs = [x for x, keep in zip(node.inputs, in_float)
                            if keep]
+            # the replay node must snapshot the SAME record-time buffers,
+            # not arg_nds' current ._data (which may have moved on) — else
+            # the mutation bug reappears one derivative order higher
             new_node = Node(lambda cts, _v=vjp2: _v(tuple(cts)),
                             arg_nds,
                             [o.shape for o in raw_outs],
                             [o.dtype for o in raw_outs],
                             name=node.name + "_backward",
-                            fwd_fn=bwd_as_fn)
+                            fwd_fn=bwd_as_fn,
+                            in_vals=vals)
             for i, (x, rc) in enumerate(zip(keep_inputs, raw_outs)):
                 cot_nd = _from_data(rc, x.ctx)
                 cot_nd._autograd_node = (new_node, i)

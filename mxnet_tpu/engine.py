@@ -50,11 +50,44 @@ def _needs_readback(arr):
         return False
 
 
+_FENCE_JIT_CAP = 256
+_FENCE_ZERO = {}  # per-device cached zero accumulator seed
+
+
+def _probe_fn(key):
+    """Per-(platform, shape, dtype, bucket) probe over ``bucket`` same-
+    signature arrays: acc + sum of each array's first element. The cache is
+    keyed on the array *signature* (plus a pow2 count bucket), never on the
+    live-array population, so waitall across steps with shifting live sets
+    reuses a bounded set of compiled probes — O(signatures x log n), and
+    each call fences a whole bucket in ONE dispatch."""
+    fn = _FENCE_JIT.get(key)
+    if fn is None:
+        import jax.numpy as jnp
+
+        def _probe(acc, *xs):
+            # a REAL data dependency on each buffer (a *0 product would
+            # constant-fold away and XLA would skip the read)
+            for x in xs:
+                if x.size:
+                    acc = acc + jax.lax.convert_element_type(
+                        x.ravel()[0], jnp.float32)
+            return acc
+        fn = jax.jit(_probe)
+        if len(_FENCE_JIT) >= _FENCE_JIT_CAP:  # pragma: no cover
+            _FENCE_JIT.clear()
+        _FENCE_JIT[key] = fn
+    return fn
+
+
 def fence(arrs):
     """Provably wait for every array in ``arrs``: block_until_ready, plus —
-    for accelerator buffers — ONE jitted scalar reduction whose value
-    depends on every buffer, read back to the host. One ~90ms readback per
-    device fences any number of arrays."""
+    for accelerator buffers — jitted scalar probes (one cached program per
+    distinct shape/dtype and pow2 count bucket) whose final value depends on
+    every buffer, read back to the host. Dispatch count is
+    O(signatures x log n), not O(arrays) — on the ~40ms-per-dispatch axon
+    tunnel a 100-buffer waitall stays a handful of dispatches plus ONE
+    ~90ms readback per device."""
     import numpy as np
     by_dev = {}
     for a in arrs:
@@ -66,25 +99,30 @@ def fence(arrs):
             dev = next(iter(a.devices()))
             by_dev.setdefault(dev, []).append(a)
     for dev, group in by_dev.items():
-        key = (dev, tuple((tuple(a.shape), str(a.dtype)) for a in group))
-        fn = _FENCE_JIT.get(key)
-        if fn is None:
-            import jax.numpy as jnp
-
-            def _scalar_probe(*xs):
-                # a REAL data dependency on each buffer (a *0 product would
-                # constant-fold away and XLA would skip the reads)
-                acc = jnp.float32(0)
-                for x in xs:
-                    if x.size:
-                        acc = acc + jax.lax.convert_element_type(
-                            x.ravel()[0], jnp.float32)
-                return acc
-            fn = jax.jit(_scalar_probe)
-            _FENCE_JIT[key] = fn
+        by_sig = {}
+        for a in group:
+            by_sig.setdefault((tuple(a.shape), str(a.dtype)), []).append(a)
+        acc = _FENCE_ZERO.get(dev)
+        if acc is None:
+            # cached per-device zero: seeding the chain must not pay a
+            # host->device transfer per fence on the ~40ms tunnel
+            acc = _FENCE_ZERO[dev] = jax.device_put(np.float32(0), dev)
+        for (shape, dtype), xs in by_sig.items():
+            i = 0
+            while i < len(xs):
+                # greedy pow2 buckets: k arrays fence in popcount(k)
+                # dispatches over at most log2(k) cached programs
+                remaining = len(xs) - i
+                bucket = 1
+                while bucket * 2 <= remaining:
+                    bucket *= 2
+                chunk = xs[i:i + bucket]
+                i += bucket
+                fn = _probe_fn((dev.platform, shape, dtype, bucket))
+                acc = fn(acc, *chunk)
         # device errors surface at this read — the reference rethrows async
         # exceptions at WaitForVar/WaitForAll the same way
-        float(np.asarray(fn(*group)))
+        float(np.asarray(acc))
 
 
 def waitall():

@@ -469,7 +469,9 @@ class Module(BaseModule):
         for name, g in grads.items():
             dst = exec_.grad_dict.get(name)
             if dst is not None:
-                dst._data = g
+                # match Executor.forward_backward: a pre-allocated grad
+                # buffer's dtype must not silently change after a fused step
+                dst._data = g if g.dtype == dst.dtype else g.astype(dst.dtype)
         fused.commit_states(indices, new_states)
         exec_.outputs = [_from_data(v, exec_._ctx) for v in outs]
         self._params_dirty = True

@@ -76,7 +76,11 @@ def invoke(op_name, inputs, params=None, out=None, name=None, ctx=None):
             in_arrs,
             [o.shape for o in out_arrs] + [a.shape for a in _aux_arrs(in_arrs, op)],
             [o.dtype for o in out_arrs] + [a.dtype for a in _aux_arrs(in_arrs, op)],
-            name=op.name, fwd_fn=fn)
+            name=op.name, fwd_fn=fn,
+            # the mutate-aux writeback above already rebound in_arrs'
+            # ._data; snapshot the PRE-mutation buffers the vjp was taken
+            # over, or create_graph replay sees post-step aux state
+            in_vals=vals)
         # note: vjp was taken over ALL fcompute outputs (incl. aux updates);
         # aux outputs receive zero cotangents via backward's fill logic.
         for i, o in enumerate(out_arrs):

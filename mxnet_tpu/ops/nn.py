@@ -320,8 +320,12 @@ def _batch_norm(params, data, gamma, beta, moving_mean, moving_var):
         new_mm, new_mv = moving_mean, moving_var
     else:
         mean = jnp.mean(data, axis=red_axes, dtype=jnp.float32)
-        m2 = jnp.mean(jnp.square(data.astype(jnp.float32)), axis=red_axes)
-        var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+        # centered two-pass variance: E[x^2]-E[x]^2 cancels catastrophically
+        # for large-mean activations (e.g. first BN over 0-255 images); the
+        # f32 cast and the subtract both fuse into the reduction, so no f32
+        # copy of the activation materializes
+        diff = data.astype(jnp.float32) - mean.reshape(bshape)
+        var = jnp.mean(jnp.square(diff), axis=red_axes)
         new_mm = lax.stop_gradient(momentum * moving_mean + (1 - momentum) * mean.astype(moving_mean.dtype))
         new_mv = lax.stop_gradient(momentum * moving_var + (1 - momentum) * var.astype(moving_var.dtype))
     inv = lax.rsqrt(var.astype(jnp.float32) + eps)

@@ -671,7 +671,8 @@ class FusedApplier:
         w_vals = [w._data for w in weights]
         g_vals = [g._data for g in grads]
 
-        key = (op_name, tuple(static.items()),
+        donate_key = _on_accelerator(weights)
+        key = (op_name, tuple(static.items()), donate_key,
                tuple((v.shape, str(v.dtype)) for v in w_vals))
         fn = self._jit_cache.get(key)
         if fn is None:
@@ -694,7 +695,7 @@ class FusedApplier:
             # user code may hold views of the old weight buffers, which
             # donation would invalidate. CPU backends don't implement
             # donation (JAX warns per compile), so gate on the device.
-            donate = (5,) if _on_accelerator(weights) else ()
+            donate = (5,) if donate_key else ()
             fn = jax.jit(apply_all, donate_argnums=donate)
             self._jit_cache[key] = fn
 

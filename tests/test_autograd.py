@@ -236,3 +236,16 @@ def test_create_graph_matches_finite_differences():
             num[i, j] = ((loss_grad_at(wp) ** 2).sum()
                          - (loss_grad_at(wm) ** 2).sum()) / (2 * eps)
     assert np.abs(analytic - num).max() < 1e-2
+
+
+def test_create_graph_second_order_after_mutation():
+    """The replay node must snapshot record-time buffers too: second-order
+    grads after in-place mutation must reflect the RECORDED values."""
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x          # dy/dx = 3x^2, d2y/dx2 = 6x
+        g1 = ag.grad(y, x, create_graph=True)
+    x[:] = 100.0               # mutate between the two grad calls
+    g2 = ag.grad(g1, x)
+    np.testing.assert_allclose(g2.asnumpy(), [12.0], rtol=1e-6)

@@ -1,0 +1,57 @@
+"""Engine fence semantics (reference Engine::WaitForAll,
+include/mxnet/engine.h:219). The fence must not recompile per live-array
+*population*: its jit cache is keyed on per-array (platform, shape, dtype)
+signatures, so waitall() across training steps with a shifting live set
+reuses a bounded set of compiled probes (ADVICE r2 medium finding)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+
+
+@pytest.fixture
+def force_readback(monkeypatch):
+    """Make fence() treat CPU buffers as relay-TPU buffers so the probe
+    path runs under the test's virtual-CPU environment."""
+    monkeypatch.setattr(engine, "_needs_readback", lambda a: True)
+    saved = dict(engine._FENCE_JIT)
+    engine._FENCE_JIT.clear()
+    yield
+    engine._FENCE_JIT.clear()
+    engine._FENCE_JIT.update(saved)
+
+
+def test_fence_cache_keyed_on_signature_not_population(force_readback):
+    # many "steps", each with a different live-array population drawn from
+    # the same two tensor signatures: the cache must be bounded by
+    # signatures x pow2-count-buckets, never by the population/grouping
+    a = jnp.ones((4, 3), jnp.float32)
+    b = jnp.ones((8,), jnp.float32)
+    for step in range(10):
+        pop = [a] * (1 + step % 3) + [b] * (step % 4)
+        engine.fence(pop)
+    # sig_a in buckets {1, 2}, sig_b in buckets {1, 2} -> at most 4 probes
+    assert len(engine._FENCE_JIT) <= 4
+
+
+def test_fence_distinct_dtypes_get_distinct_probes(force_readback):
+    engine.fence([jnp.ones((4,), jnp.float32), jnp.ones((4,), jnp.bfloat16)])
+    assert len(engine._FENCE_JIT) == 2
+
+
+def test_fence_handles_empty_and_int_arrays(force_readback):
+    engine.fence([jnp.zeros((0,), jnp.float32), jnp.arange(3),
+                  jnp.ones((2, 2), bool)])
+
+
+def test_waitall_is_idempotent_across_steps(force_readback):
+    for step in range(3):
+        x = mx.nd.ones((4, 4)) * (step + 1)
+        y = (x * 2).sum()
+        mx.nd.waitall()
+        assert float(y.asnumpy()) == 32.0 * (step + 1)
+    # probes accumulated per signature only; far fewer than live arrays
+    assert len(engine._FENCE_JIT) < 16

@@ -193,3 +193,36 @@ def test_small_parity_modules():
     assert isinstance(create("impl2"), Impl)
     srv = mx.kvstore_server.KVStoreServer(mx.kv.create("local"))
     assert callable(srv._controller())
+
+
+def test_batchnorm_variance_large_mean_stable():
+    """ADVICE r2: E[x^2]-E[x]^2 cancels catastrophically for large-mean
+    activations (first BN over 0-255 images); the centered two-pass form
+    must match numpy's variance."""
+    rng = np.random.RandomState(7)
+    x = (rng.rand(4, 3, 8, 8) * 255.0).astype(np.float32) + 1e4
+    data = mx.nd.array(x)
+    gamma = mx.nd.ones((3,))
+    beta = mx.nd.zeros((3,))
+    mm = mx.nd.zeros((3,))
+    mv = mx.nd.ones((3,))
+    with mx.autograd.record(train_mode=True):
+        out = mx.nd.BatchNorm(data, gamma, beta, mm, mv, fix_gamma=False,
+                              eps=1e-5)
+    got = out[0].asnumpy() if isinstance(out, list) else out.asnumpy()
+    ref_mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    ref_var = x.var(axis=(0, 2, 3), keepdims=True)
+    want = (x - ref_mean) / np.sqrt(ref_var + 1e-5)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_create_graph_replay_uses_recorded_inputs():
+    """ADVICE r2: grad(create_graph=True) must replay the forward on the
+    RECORDED input buffers, not the current ones after in-place mutation."""
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * x * x          # y = x^3, dy/dx = 3x^2 = 12 at x=2
+    x[:] = 100.0               # mutate AFTER recording, BEFORE the replay
+    gx = mx.autograd.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.asnumpy(), [12.0], rtol=1e-6)
