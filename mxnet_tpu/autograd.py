@@ -197,7 +197,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             break
 
     import contextlib
-    dev_scope = jax.default_device(tape_dev) if tape_dev is not None \
+    # a Sharding (SPMD tape) can't pin jax's default device; constants then
+    # materialize on the default device and ops reshard them as needed
+    dev_scope = jax.default_device(tape_dev) \
+        if tape_dev is not None and not hasattr(tape_dev, "device_set") \
         else contextlib.nullcontext()
     with dev_scope:
         any_tape = False
@@ -346,7 +349,10 @@ def _grad_taped(heads, variables, head_grads=None, train_mode=True):
         if tape_dev is not None:
             break
     import contextlib
-    dev_scope = jax.default_device(tape_dev) if tape_dev is not None \
+    # a Sharding (SPMD tape) can't pin jax's default device; constants then
+    # materialize on the default device and ops reshard them as needed
+    dev_scope = jax.default_device(tape_dev) \
+        if tape_dev is not None and not hasattr(tape_dev, "device_set") \
         else contextlib.nullcontext()
 
     with dev_scope, _RecordingScope(True, train_mode):

@@ -17,12 +17,17 @@ __all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
 
 
 def device_of(val):
-    """First device holding `val` (a jax.Array), or None when it has no
-    device (tracer, numpy). The shared helper behind every "keep this
-    constant on the data's device" placement decision."""
+    """Placement of `val` (a jax.Array): its single device, or its Sharding
+    when it spans several devices (SPMD data parallelism), or None when it
+    has no device (tracer, numpy). Both forms are accepted by
+    ``jax.device_put`` / ``jnp.zeros(device=...)``, so every "keep this
+    constant on the data's placement" decision is sharding-preserving."""
     if hasattr(val, "devices"):
         try:
-            return next(iter(val.devices()))
+            devs = val.devices()
+            if len(devs) > 1:
+                return val.sharding
+            return next(iter(devs))
         except Exception:
             return None
     return None

@@ -85,9 +85,13 @@ def _build_eval(sym: Symbol, ctx=None):
 
 
 class Executor:
-    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req):
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                 shardings=None):
         self._symbol = symbol
         self._ctx = ctx
+        # name -> jax.sharding.Sharding for SPMD data parallelism (Module
+        # with a multi-device context list); None = single-device executor
+        self._shardings = shardings
         self.arg_dict = arg_dict            # name -> NDArray (shared, mutable)
         self.grad_dict = grad_dict          # name -> NDArray or None
         self.aux_dict = aux_dict
@@ -97,6 +101,14 @@ class Executor:
         self._output_names = symbol.list_outputs()
         self._eval_fn = _build_eval(symbol, ctx)
         self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
+        if shardings:
+            # replicated placement on the same mesh, for the RNG key: a jit
+            # whose args span the mesh rejects a single-device key
+            from jax.sharding import NamedSharding, PartitionSpec
+            any_s = next(iter(shardings.values()))
+            self._repl_sharding = NamedSharding(any_s.mesh, PartitionSpec())
+        else:
+            self._repl_sharding = None
         self._grad_names = [n for n in self._arg_names
                             if grad_req.get(n, "null") != "null"]
         self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
@@ -109,13 +121,26 @@ class Executor:
     @staticmethod
     def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
                     group2ctx=None, shared_exec=None, shared_buffer=None,
-                    **kwargs):
+                    shardings=None, **kwargs):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         arg_shapes_d, _, aux_shapes_d = _graph_infer(symbol, kwargs,
                                                      type_dict=type_dict)
         type_dict = type_dict or {}
         req = _norm_req(grad_req, arg_names, kwargs)
+        if shardings is None and shared_exec is not None:
+            shardings = shared_exec._shardings
+
+        def _make(name, shape, dt):
+            # SPMD executors place every buffer with its mesh sharding up
+            # front (params/aux replicated, batch args dp-sharded); the
+            # reference instead allocates per-device executors
+            # (executor_group.py:129) — here ONE program spans the mesh
+            if shardings is not None and name in shardings:
+                return _from_data(jnp.zeros(tuple(shape), dt,
+                                            device=shardings[name]), ctx)
+            return nd_zeros(shape, ctx=ctx, dtype=dt)
+
         arg_dict = {}
         grad_dict = {}
         for name in arg_names:
@@ -132,11 +157,11 @@ class Executor:
                     shared_buffer[name].shape == tuple(shape):
                 arg_dict[name] = shared_buffer[name]
             else:
-                arg_dict[name] = nd_zeros(shape, ctx=ctx, dtype=dt)
+                arg_dict[name] = _make(name, shape, dt)
                 if shared_buffer is not None:
                     shared_buffer[name] = arg_dict[name]
             if req.get(name, "null") != "null" and name not in grad_dict:
-                grad_dict[name] = nd_zeros(shape, ctx=ctx, dtype=dt)
+                grad_dict[name] = _make(name, shape, dt)
         aux_dict = {}
         for name in aux_names:
             shape = aux_shapes_d.get(name)
@@ -146,9 +171,10 @@ class Executor:
                     shared_exec.aux_dict[name].shape == tuple(shape):
                 aux_dict[name] = shared_exec.aux_dict[name]
             else:
-                aux_dict[name] = nd_zeros(shape, ctx=ctx,
-                                          dtype=type_dict.get(name, np.float32))
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+                aux_dict[name] = _make(name, shape,
+                                       type_dict.get(name, np.float32))
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req,
+                        shardings=shardings)
 
     @staticmethod
     def bind(symbol, ctx, args, args_grad=None, grad_req="write",
@@ -171,6 +197,8 @@ class Executor:
 
     def _next_key(self):
         from . import random as _random
+        if self._repl_sharding is not None:
+            return _random._split_chain(self._repl_sharding)
         return _random.next_key(self._ctx)
 
     def forward(self, is_train=False, **kwargs):

@@ -34,6 +34,7 @@ class Parameter:
         self._data = None
         self._grad = None
         self._ctx = None
+        self._ctx_list = None
         self._deferred_init = ()
         self.name = name
         self._shape = tuple(shape) if shape is not None else None
@@ -90,7 +91,11 @@ class Parameter:
         if ctx is None:
             ctx = current_context()
         if isinstance(ctx, (list, tuple)):
-            ctx = ctx[0]
+            # multi-device ctx list => SPMD: ONE replicated array over a
+            # 'dp' mesh of those devices (the reference keeps a per-device
+            # copy list instead, parameter.py:check_and_get). Single-entry
+            # lists collapse to the plain single-device path.
+            ctx = list(ctx) if len(ctx) > 1 else ctx[0]
         if self._shape is None or np.prod(self._shape) <= 0:
             if self.allow_deferred_init:
                 self._deferred_init = (init, ctx, default_init, None)
@@ -108,8 +113,11 @@ class Parameter:
         assert self._shape is not None and np.prod(self._shape) > 0, \
             "Cannot initialize Parameter '%s' because it has invalid shape: %s." \
             % (self.name, str(self._shape))
+        gen_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
         if data is None:
-            data = nd_zeros(self._shape, ctx=ctx, dtype=self.dtype)
+            # values are generated once on the lead device; _init_impl
+            # replicates them over the mesh for a multi-device ctx
+            data = nd_zeros(self._shape, ctx=gen_ctx, dtype=self.dtype)
             effective = init if init is not None else (self.init or default_init)
             if isinstance(effective, str):
                 effective = init_create(effective)
@@ -117,7 +125,18 @@ class Parameter:
         self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx):
-        self._ctx = ctx
+        if isinstance(ctx, (list, tuple)):
+            import jax
+            from ..parallel.mesh import replicated_sharding
+            self._ctx_list = list(ctx)
+            self._ctx = ctx[0]
+            # replicate over the dp mesh; eager ops, autograd and the
+            # Trainer's fused update then all run SPMD over the mesh
+            data._data = jax.device_put(
+                data._data, replicated_sharding([c.jax_device() for c in ctx]))
+        else:
+            self._ctx_list = None
+            self._ctx = ctx
         self._data = data
         self._init_grad()
 
@@ -125,7 +144,14 @@ class Parameter:
         if self.grad_req == "null":
             self._grad = None
             return
-        self._grad = nd_zeros(self._data.shape, ctx=self._ctx, dtype=self._data.dtype)
+        import jax.numpy as jnp
+        from ..base import device_of
+        from ..ndarray.ndarray import _from_data
+        # same placement as the data (its device, or its mesh sharding for
+        # SPMD parameters)
+        self._grad = _from_data(
+            jnp.zeros(self._data.shape, self._data.dtype,
+                      device=device_of(self._data._data)), self._ctx)
         from .. import autograd
         autograd.mark_variables([self._data], [self._grad], self.grad_req)
 
@@ -141,6 +167,14 @@ class Parameter:
         if self.dtype is not None and np.dtype(self.dtype) != data.dtype:
             data = data.astype(self.dtype)
         if isinstance(ctx, (list, tuple)):
+            if len(ctx) > 1:
+                # multi-device load => SPMD replicated (see initialize)
+                if self._data is None:
+                    self._deferred_init = ()
+                    self._init_impl(data.as_in_context(ctx[0]), list(ctx))
+                else:
+                    self.set_data(data)
+                return
             ctx = ctx[0] if ctx else None
         if self._data is None:
             self._deferred_init = ()
@@ -155,9 +189,10 @@ class Parameter:
             assert self._deferred_init, \
                 "Parameter '%s' has not been initialized" % self.name
             init, ctx, default_init, _ = self._deferred_init
+            gen_ctx = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
             self._deferred_init = (init, ctx, default_init,
                                    data if isinstance(data, NDArray) else
-                                   nd_array(data, ctx=ctx))
+                                   nd_array(data, ctx=gen_ctx))
             self._finish_deferred_init()
             return
         if not isinstance(data, NDArray):
@@ -186,9 +221,10 @@ class Parameter:
     def list_ctx(self):
         if self._data is None:
             if self._deferred_init:
-                return [self._deferred_init[1]]
+                ctx = self._deferred_init[1]
+                return list(ctx) if isinstance(ctx, (list, tuple)) else [ctx]
             raise RuntimeError("Parameter '%s' has not been initialized" % self.name)
-        return [self._ctx]
+        return list(self._ctx_list) if self._ctx_list else [self._ctx]
 
     def zero_grad(self):
         if self._grad is None:
@@ -199,9 +235,19 @@ class Parameter:
         if isinstance(ctx, Context):
             ctx = [ctx]
         if self._data is not None:
-            self._data = self._data.as_in_context(ctx[0])
-            self._ctx = ctx[0]
-            self._init_grad()
+            if len(ctx) > 1:
+                self._init_impl(self._data, list(ctx))
+            else:
+                import jax
+                # as_in_context is a no-op when the nominal ctx matches, but
+                # a previously mesh-replicated array must still collapse to
+                # the single device
+                self._data = NDArray(
+                    jax.device_put(self._data._data, ctx[0].jax_device()),
+                    ctx[0])
+                self._ctx = ctx[0]
+                self._ctx_list = None
+                self._init_grad()
 
     def var(self):
         from .. import symbol

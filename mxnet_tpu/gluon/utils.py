@@ -38,13 +38,29 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Reference utils.py split_and_load. On TPU, prefer a mesh-sharded
-    batch (`parallel.split_and_load_sharded`) — this per-device list form is
-    kept for reference API compatibility."""
+    """Reference utils.py split_and_load.
+
+    TPU-native divergence for a multi-device ctx list: instead of the
+    reference's per-device slice list (one eager program per device), the
+    batch is placed ONCE, sharded along ``batch_axis`` over a 'dp' mesh of
+    the devices, and returned as a single-element list. A reference-style
+    loop (``for x in split_and_load(...): loss = net(x)``) then runs one
+    SPMD program spanning every device — same math, one dispatch. Pair with
+    parameters initialized with the same ctx list (replicated)."""
     if not isinstance(data, NDArray):
         data = array(data, ctx=ctx_list[0])
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
+    devices = [c.jax_device() for c in ctx_list]
+    if len(set(devices)) == len(devices) and batch_axis == 0 and \
+            data.shape[0] % len(ctx_list) == 0:
+        import jax
+        from ..parallel.mesh import batch_sharding
+        from ..ndarray.ndarray import _from_data
+        return [_from_data(jax.device_put(data._data,
+                                          batch_sharding(devices)),
+                           ctx_list[0])]
+    # fallback (duplicate devices / uneven batch): reference-style slices
     slices = split_data(data, len(ctx_list), batch_axis, even_split)
     return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
 

@@ -153,8 +153,14 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """Reference base_module.py:395 training loop."""
+            monitor=None, sparse_row_id_fn=None, batches_per_dispatch=1):
+        """Reference base_module.py:395 training loop.
+
+        TPU extension: ``batches_per_dispatch=K`` groups K batches into ONE
+        device dispatch (`Module._step_scan`: the batches are staged to the
+        device and a lax.scan carries params/optimizer state through the K
+        fused train steps). Metrics and batch callbacks still fire per
+        batch, from the scan's stacked per-step outputs."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -176,6 +182,8 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        use_scan = batches_per_dispatch > 1 and monitor is None and \
+            hasattr(self, "_step_scan")
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -184,6 +192,48 @@ class BaseModule:
             end_of_batch = False
             next_data_batch = next(data_iter)
             while not end_of_batch:
+                if use_scan:
+                    # gather up to K batches, run them in one dispatch
+                    group = [next_data_batch]
+                    while len(group) < batches_per_dispatch:
+                        try:
+                            nb = next(data_iter)
+                            self.prepare(nb, sparse_row_id_fn=sparse_row_id_fn)
+                        except StopIteration:
+                            end_of_batch = True
+                            break
+                        if nb.data[0].shape != group[0].data[0].shape:
+                            next_data_batch = nb  # bucketing boundary
+                            break
+                        group.append(nb)
+                    else:
+                        try:
+                            next_data_batch = next(data_iter)
+                            self.prepare(next_data_batch,
+                                         sparse_row_id_fn=sparse_row_id_fn)
+                        except StopIteration:
+                            end_of_batch = True
+                    stacked = self._step_scan(group) if len(group) > 1 \
+                        else False
+                    for k_i, b in enumerate(group):
+                        if stacked is False:  # unsupported: per-batch steps
+                            self._step(b)
+                        if stacked:
+                            outs = {name: out[k_i] for name, out in
+                                    zip(self.output_names, stacked)}
+                            eval_metric.update_dict(
+                                dict(zip(self._label_names, b.label or [])),
+                                outs)
+                        else:
+                            self.update_metric(eval_metric, b.label)
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
+                        nbatch += 1
+                    continue
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()

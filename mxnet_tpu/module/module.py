@@ -99,6 +99,7 @@ class Module(BaseModule):
         self._grad_req = None
         self._monitor = None
         self._fused_plan = None
+        self._scan_plans = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -228,7 +229,12 @@ class Module(BaseModule):
     # -- bind ------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
+             grad_req="write", type_dict=None):
+        """``type_dict`` (TPU extension): per-argument dtype overrides, e.g.
+        ``{'data': 'bfloat16', **{p: 'bfloat16' for p in param_names}}`` for
+        MXU-native bf16 training; aux states (BN moving stats) keep f32
+        unless named explicitly. The reference reaches the same state via
+        per-var __dtype__ attrs + infer_type."""
         if force_rebind:
             self._exec = None
             self.binded = False
@@ -259,9 +265,13 @@ class Module(BaseModule):
 
         shared_exec = shared_module._exec if shared_module is not None else None
         self._fused_plan = None
+        self._scan_plans = None
         ctx = self._context[0]
+        shardings = self._dp_shardings(shapes)
         self._exec = Executor.simple_bind(self._symbol, ctx, grad_req=req,
-                                          shared_exec=shared_exec, **shapes)
+                                          shared_exec=shared_exec,
+                                          shardings=shardings,
+                                          type_dict=type_dict, **shapes)
         from ..symbol.symbol import _graph_infer
         _, self._out_shapes, _ = _graph_infer(self._symbol, shapes)
         self.binded = True
@@ -280,6 +290,39 @@ class Module(BaseModule):
             self.params_initialized = True
             self._sync_params_from_devices()
 
+    def _dp_shardings(self, shapes):
+        """SPMD data parallelism over a multi-device context list: ONE
+        executor whose buffers live on a 'dp' mesh — inputs batch-sharded,
+        params/aux replicated; XLA inserts the gradient all-reduce. The
+        reference instead runs one executor per device and reduces grads
+        through the KVStore (executor_group.py:129,289,330); the in-program
+        psum subsumes that reduction.
+
+        Returns None for a single-device context (plain executor)."""
+        if len(self._context) <= 1:
+            return None
+        from ..parallel.mesh import batch_sharding, replicated_sharding
+        devices = [c.jax_device() for c in self._context]
+        ndev = len(devices)
+        input_names = set(self._data_names) | set(self._label_names) \
+            | set(self._state_names)
+        for name, shape in shapes.items():
+            if not shape or shape[0] % ndev != 0:
+                raise MXNetError(
+                    "input %s batch dim %s is not divisible by the %d "
+                    "devices of the dp mesh" % (name, shape, ndev))
+        try:
+            batched = batch_sharding(devices)  # shared cached dp mesh
+        except ValueError as e:  # duplicate devices in the context list
+            raise MXNetError(str(e))
+        repl = replicated_sharding(devices)
+        shardings = {}
+        for name in self._symbol.list_arguments():
+            shardings[name] = batched if name in input_names else repl
+        for name in self._aux_names:
+            shardings[name] = repl
+        return shardings
+
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
         self._data_shapes = _norm_shapes(data_shapes)
@@ -289,6 +332,7 @@ class Module(BaseModule):
             shapes[desc[0]] = desc[1]
         self._exec = self._exec.reshape(**shapes)
         self._fused_plan = None
+        self._scan_plans = None
 
     # -- optimizer -------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -302,9 +346,16 @@ class Module(BaseModule):
             self._sync_params_from_devices()
         self._fused = None  # re-resolve the fused applier per optimizer
         self._fused_plan = None
+        self._scan_plans = None
+        # SPMD multi-device modules reduce gradients in-program (psum over
+        # the dp mesh), so the reference's local-kvstore grad reduction
+        # (model.py:_create_kvstore num_device>1) is already done: treat as
+        # one logical device. Explicit dist kvstores still apply on top.
+        eff_devices = 1 if self._exec._shardings is not None \
+            else len(self._context)
         (kvstore, update_on_kvstore) = _create_kvstore(
-            kvstore, len(self._context), {n: self._exec.arg_dict[n]
-                                          for n in self._param_names})
+            kvstore, eff_devices, {n: self._exec.arg_dict[n]
+                                   for n in self._param_names})
         batch_size = self._data_shapes[0][1][0]
         if kvstore and "dist" in kvstore.type and "_async" not in kvstore.type:
             batch_size *= kvstore.num_workers
@@ -359,6 +410,7 @@ class Module(BaseModule):
         self._updater = shared_module._updater
         self._fused = None  # re-resolve against the borrowed updater
         self._fused_plan = None
+        self._scan_plans = None
         self.optimizer_initialized = True
 
     # -- compute ---------------------------------------------------------
@@ -446,7 +498,7 @@ class Module(BaseModule):
             self.update()
             return
         from ..ndarray.ndarray import _from_data
-        live_names, indices, fused, step_fn = self._fused_plan
+        live_names, indices, fused, step_fn, _ = self._fused_plan
         self._load_batch(data_batch)
         exec_ = self._exec
         arg_vals, aux_vals = exec_._gather()
@@ -477,7 +529,8 @@ class Module(BaseModule):
         self._params_dirty = True
 
     def _build_fused_step(self):
-        """Build (live_names, FusedApplier, jitted step) or False."""
+        """Build (live_names, indices, FusedApplier, jitted step, raw step)
+        or False."""
         if self._kvstore is not None or self._updater is None \
                 or self._monitor is not None:
             return False
@@ -528,7 +581,124 @@ class Module(BaseModule):
             not in ("cpu", "cpu_pinned", "cpu_shared") else ()
         step_fn = jax.jit(step, donate_argnums=donate)
         indices = [self._param_names.index(n) for n in live_names]
-        return (live_names, indices, fused, step_fn)
+        return (live_names, indices, fused, step_fn, step)
+
+    # -- scanned multi-batch step ---------------------------------------
+    def _step_scan(self, data_batches):
+        """Run ``len(data_batches)`` fused train steps in ONE device
+        dispatch: the batches are stacked and staged to the device up
+        front, and a ``lax.scan`` carries (params, optimizer states, aux,
+        RNG key) through the K steps.
+
+        TPU-native throughput feature with no reference analog: the
+        reference pays one engine push per op per batch
+        (graph_executor.cc:1377); the fused `_step` already collapses a
+        step to one dispatch, and this collapses K steps to one — on a
+        high-latency link (or with fast steps) training becomes
+        device-bound instead of dispatch-bound. Used by ``fit(...,
+        batches_per_dispatch=K)``.
+
+        Returns the per-step stacked outputs (list over module outputs,
+        each with leading axis K) for metric updates; grad_dict is NOT
+        rebound (use plain `_step` when per-batch gradients are needed).
+        """
+        K = len(data_batches)
+        if K == 1:
+            self._step(data_batches[0])
+            return None
+        if self._fused_plan is None:
+            self._fused_plan = self._build_fused_step()
+        plan_key = ("scan", K)
+        scan_fn = None if self._scan_plans is None \
+            else self._scan_plans.get(plan_key)
+        if self._fused_plan is False or self.inputs_need_grad:
+            return False  # caller steps per-batch (metrics stay per-batch)
+        import numpy as _np
+        import jax
+        from ..ndarray.ndarray import _from_data
+        live_names, indices, fused, _, step_raw = self._fused_plan
+        exec_ = self._exec
+        if scan_fn is None:
+            from jax import lax
+
+            def scan_step(grad_args, consts, stacked, aux_vals, key,
+                          lrs, wds, rescale, state_vals):
+                def body(carry, xs):
+                    ga, aux, sv, k = carry
+                    k, sub = jax.random.split(k)
+                    outs, aux_up, new_ws, new_states, _ = step_raw(
+                        ga, {**consts, **xs}, aux, sub, lrs, wds, rescale,
+                        sv)
+                    ga = dict(ga)
+                    for n, w in zip(live_names, new_ws):
+                        ga[n] = w
+                    return (ga, {**aux, **aux_up}, list(new_states), k), \
+                        tuple(outs)
+                (ga, aux, sv, _), outs = lax.scan(
+                    body, (grad_args, aux_vals, state_vals, key), stacked)
+                return ga, aux, sv, outs
+
+            donate = (8,) if getattr(self._context[0], "device_type", "cpu") \
+                not in ("cpu", "cpu_pinned", "cpu_shared") else ()
+            scan_fn = jax.jit(scan_step, donate_argnums=donate)
+            if self._scan_plans is None:
+                self._scan_plans = {}
+            self._scan_plans[plan_key] = scan_fn
+
+        # stack K batches -> one (K, batch, ...) input per arg. Device-
+        # resident batches stack on-device (no host round trip — benchmark
+        # batches live on the chip); host batches stack in numpy and move
+        # in ONE transfer.
+        import jax.numpy as jnp
+
+        def _stack(vals):
+            if all(isinstance(v, NDArray) for v in vals):
+                return jnp.stack([v._data for v in vals])
+            return _np.stack([v.asnumpy() if hasattr(v, "asnumpy")
+                              else _np.asarray(v) for v in vals])
+
+        stacked = {}
+        for i, name in enumerate(self._data_names):
+            stacked[name] = _stack([b.data[i] for b in data_batches])
+        for i, name in enumerate(self._label_names):
+            if name not in exec_.arg_dict:
+                continue
+            stacked[name] = _stack([b.label[i] for b in data_batches])
+        placed = {}
+        for name, arr in stacked.items():
+            dst = exec_.arg_dict[name]
+            if arr.dtype != dst.dtype:
+                arr = arr.astype(dst.dtype)
+            if exec_._shardings is not None and name in exec_._shardings:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                sh = exec_._shardings[name]
+                spec = P(*((None,) + tuple(sh.spec)))
+                placed[name] = jax.device_put(
+                    arr, NamedSharding(sh.mesh, spec))
+            else:
+                from ..base import device_of
+                dev = device_of(dst._data)
+                cur = None if isinstance(arr, _np.ndarray) else device_of(arr)
+                placed[name] = arr if cur == dev \
+                    else jax.device_put(arr, dev)
+
+        arg_vals, aux_vals = exec_._gather()
+        grad_args = {n: arg_vals[n] for n in exec_._grad_names}
+        consts = {n: v for n, v in arg_vals.items()
+                  if n not in exec_._grad_names and n not in placed}
+        weights = [exec_.arg_dict[n] for n in live_names]
+        lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+        key = exec_._next_key()
+        ga, aux, sv, outs = scan_fn(grad_args, consts, placed, aux_vals,
+                                    key, lrs, wds, rescale, state_vals)
+        for name, val in aux.items():
+            exec_.aux_dict[name]._data = val
+        for w, name in zip(weights, live_names):
+            w._data = ga[name]
+        fused.commit_states(indices, sv)
+        exec_.outputs = [_from_data(o[-1], exec_._ctx) for o in outs]
+        self._params_dirty = True
+        return [_from_data(o, exec_._ctx) for o in outs]
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -559,6 +729,7 @@ class Module(BaseModule):
         assert self.binded
         self._monitor = mon
         self._fused_plan = None
+        self._scan_plans = None
         mon.install(self._exec)
 
     def save_optimizer_states(self, fname):

@@ -297,7 +297,7 @@ def _upsampling(params, *inputs):
 # Normalisation
 # ---------------------------------------------------------------------------
 @register("BatchNorm", aliases=("BatchNorm_v1",), need_train_flag=True,
-          num_outputs=3, mutate_aux=(3, 4))
+          num_outputs=3, mutate_aux=(3, 4), num_visible_outputs=1)
 def _batch_norm(params, data, gamma, beta, moving_mean, moving_var):
     """Reference nn/batch_norm-inl.h. Outputs (out, mean, var); updates the
     moving stats aux inputs in place during training.
@@ -339,7 +339,7 @@ def _batch_norm(params, data, gamma, beta, moving_mean, moving_var):
             new_mm, new_mv)
 
 
-@register("LayerNorm", num_outputs=3)
+@register("LayerNorm", num_outputs=3, num_visible_outputs=1)
 def _layer_norm(params, data, gamma, beta):
     """Reference nn/layer_norm.cc; statistics in fp32 for bf16 stability."""
     axis = params.get("axis", -1)
@@ -448,7 +448,8 @@ def _softmax_activation(params, data):
     return (jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape),)
 
 
-@register("Dropout", need_rng=True, need_train_flag=True, num_outputs=2)
+@register("Dropout", need_rng=True, need_train_flag=True, num_outputs=2,
+          num_visible_outputs=1)
 def _dropout(params, data):
     """Reference nn/dropout-inl.h; outputs (out, mask)."""
     p = params.get("p", 0.5)

@@ -27,7 +27,8 @@ _OPS = {}
 
 class Operator:
     def __init__(self, name, fcompute, num_outputs=1, need_train_flag=False,
-                 need_rng=False, visible=True, mutate_aux=None, doc=""):
+                 need_rng=False, visible=True, mutate_aux=None, doc="",
+                 num_visible_outputs=None):
         self.name = name
         self.fcompute = fcompute
         # int, or callable(params)->int for variable-output ops (e.g. split)
@@ -39,6 +40,10 @@ class Operator:
         # stats; reference mutable aux states). fcompute returns the new
         # values appended after the regular outputs.
         self.mutate_aux = mutate_aux or ()
+        # reference num_visible_outputs (nnvm FNumVisibleOutputs): extra
+        # outputs (BatchNorm mean/var, Dropout mask) exist imperatively but
+        # are hidden from symbolic composition and executor outputs
+        self.num_visible_outputs = num_visible_outputs
         self.doc = doc
 
     def n_out(self, params):
@@ -46,19 +51,26 @@ class Operator:
             return self.num_outputs(params)
         return self.num_outputs
 
+    def n_visible(self, params):
+        if self.num_visible_outputs is None:
+            return self.n_out(params)
+        return self.num_visible_outputs
+
     def __repr__(self):
         return "Operator(%s)" % self.name
 
 
 def register(name, num_outputs=1, aliases=(), need_train_flag=False,
-             need_rng=False, visible=True, mutate_aux=None):
+             need_rng=False, visible=True, mutate_aux=None,
+             num_visible_outputs=None):
     """Decorator registering ``fcompute`` under ``name`` (+aliases)."""
 
     def deco(fcompute):
         op = Operator(name, fcompute, num_outputs=num_outputs,
                       need_train_flag=need_train_flag, need_rng=need_rng,
                       visible=visible, mutate_aux=mutate_aux,
-                      doc=fcompute.__doc__ or "")
+                      doc=fcompute.__doc__ or "",
+                      num_visible_outputs=num_visible_outputs)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op

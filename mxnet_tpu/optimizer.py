@@ -24,6 +24,101 @@ __all__ = ["Optimizer", "SGD", "NAG", "Signum", "Adam", "AdaGrad", "AdaDelta",
            "Updater", "get_updater", "create", "register"]
 
 
+
+_SPARSE_ROW_JIT = {}
+
+
+def _is_lazy_rowsparse(grad):
+    """Row-sparse gradient still carrying its compact payload — the state
+    the O(nnz) lazy update paths key on."""
+    from .ndarray.sparse import RowSparseNDArray
+    return isinstance(grad, RowSparseNDArray) and grad.has_compact()
+
+
+def _sparse_row_update(kind, weight, grad, states, scalars):
+    """O(nnz) lazy row update over a compact row-sparse gradient (reference
+    `src/operator/optimizer_op.cc:287-330,610` SGDUpdateRspImpl /
+    AdamUpdateRspImpl): gather the touched rows of the weight/state, update
+    them in f32, scatter back. Work and memory scale with nnz, not the
+    dense row count.
+
+    TPU form: nnz pads to the next pow2 (bounded jit cache, one compiled
+    program per bucket); padded lanes use an out-of-range row index whose
+    scatter is dropped (`mode='drop'`)."""
+    import jax
+    import jax.numpy as jnp
+
+    vals, idx = grad.compact()
+    rows = weight.shape[0]
+    n = int(vals.shape[0])
+    if n == 0:
+        return
+    bucket = 1 << (n - 1).bit_length()
+    pad = bucket - n
+    if pad:
+        idx = jnp.concatenate(
+            [idx, jnp.full((pad,), rows, idx.dtype)])
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((pad,) + vals.shape[1:], vals.dtype)])
+    key = (kind, tuple(weight.shape), str(weight.dtype), bucket,
+           tuple(sorted(scalars)))
+    fn = _SPARSE_ROW_JIT.get(key)
+    if fn is None:
+        def kernel(w, sts, idx, vals, sc):
+            g = vals.astype(jnp.float32) * sc["rescale_grad"]
+            if "clip_gradient" in sc:
+                g = jnp.clip(g, -sc["clip_gradient"], sc["clip_gradient"])
+            # padded lanes gather a clamped row (garbage) and scatter with
+            # mode='drop' — no effect on the result
+            wr = w[idx].astype(jnp.float32)
+            g = g + sc["wd"] * wr
+            if kind == "sgd":
+                neww = w.at[idx].add((-sc["lr"] * g).astype(w.dtype),
+                                     mode="drop")
+                return neww, sts
+            if kind == "sgd_mom":
+                (m,) = sts
+                newm = sc["momentum"] * m[idx] + g
+                neww = w.at[idx].add((-sc["lr"] * newm).astype(w.dtype),
+                                     mode="drop")
+                return neww, (m.at[idx].set(newm, mode="drop"),)
+            if kind == "adam":
+                m, v = sts
+                newm = sc["beta1"] * m[idx] + (1 - sc["beta1"]) * g
+                newv = sc["beta2"] * v[idx] + (1 - sc["beta2"]) * g * g
+                upd = sc["lr"] * newm / (jnp.sqrt(newv) + sc["epsilon"])
+                neww = w.at[idx].add((-upd).astype(w.dtype), mode="drop")
+                return neww, (m.at[idx].set(newm, mode="drop"),
+                              v.at[idx].set(newv, mode="drop"))
+            if kind == "adagrad":
+                (h,) = sts
+                newh = h[idx] + g * g
+                upd = sc["lr"] * g / (jnp.sqrt(newh) + sc["epsilon"])
+                neww = w.at[idx].add((-upd).astype(w.dtype), mode="drop")
+                return neww, (h.at[idx].set(newh, mode="drop"),)
+            raise ValueError(kind)
+        fn = jax.jit(kernel)
+        _SPARSE_ROW_JIT[key] = fn
+    st_vals = tuple(s._data for s in states)
+    sc = {k: float(v) for k, v in scalars.items()}
+    neww, newst = fn(weight._data, st_vals, idx, vals, sc)
+    weight._data = neww
+    for s, ns in zip(states, newst):
+        s._data = ns
+
+
+def _state_zeros(weight, dtype=None):
+    """Optimizer state co-located with the weight: same device — or same
+    mesh sharding when the weight belongs to an SPMD (multi-device) module —
+    so the fused update's jit sees a consistent placement set."""
+    import jax.numpy as jnp
+    from .base import device_of
+    from .ndarray.ndarray import _from_data
+    dev = device_of(weight._data)
+    return _from_data(jnp.zeros(weight.shape, dtype or weight.dtype,
+                                device=dev), weight.context)
+
+
 class Optimizer:
     opt_registry = {}
 
@@ -173,12 +268,20 @@ class SGD(Optimizer):
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
-            return zeros(weight.shape, weight.context, dtype="float32")
+            return _state_zeros(weight, dtype="float32")
         return None
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kw = self._common_kwargs(index)
+        if self.lazy_update and _is_lazy_rowsparse(grad):
+            # O(nnz) row update (reference SGDUpdateRspImpl lazy_update)
+            if state is not None:
+                kw["momentum"] = self.momentum
+                _sparse_row_update("sgd_mom", weight, grad, (state,), kw)
+            else:
+                _sparse_row_update("sgd", weight, grad, (), kw)
+            return
         if state is not None:
             kw["momentum"] = self.momentum
             invoke("sgd_mom_update", [weight, grad, state], kw, out=weight)
@@ -229,7 +332,7 @@ class Signum(Optimizer):
 
     def create_state(self, index, weight):
         if self.momentum != 0.0:
-            return zeros(weight.shape, weight.context, dtype="float32")
+            return _state_zeros(weight, dtype="float32")
         return None
 
     def update(self, index, weight, grad, state):
@@ -246,15 +349,16 @@ class Signum(Optimizer):
 @register
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, **kwargs):
+                 epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype="float32"),
-                zeros(weight.shape, weight.context, dtype="float32"))
+        return (_state_zeros(weight, dtype="float32"),
+                _state_zeros(weight, dtype="float32"))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -265,6 +369,10 @@ class Adam(Optimizer):
         kw["lr"] = kw["lr"] * math.sqrt(coef2) / coef1
         kw.update({"beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon})
         mean, var = state
+        if self.lazy_update and _is_lazy_rowsparse(grad):
+            # O(nnz) row update (reference AdamUpdateRspImpl lazy_update)
+            _sparse_row_update("adam", weight, grad, (mean, var), kw)
+            return
         invoke("adam_update", [weight, grad, mean, var], kw, out=weight)
 
 
@@ -275,12 +383,20 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype="float32")
+        return _state_zeros(weight, dtype="float32")
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if _is_lazy_rowsparse(grad):
+            # O(nnz) row update (reference AdagradUpdateRspImpl)
+            kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "epsilon": self.float_stable_eps}
+            if self.clip_gradient is not None:
+                kw["clip_gradient"] = self.clip_gradient
+            _sparse_row_update("adagrad", weight, grad, (state,), kw)
+            return
         g = grad.astype("float32") * self.rescale_grad
         if self.clip_gradient is not None:
             g = g.clip(-self.clip_gradient, self.clip_gradient)
@@ -298,8 +414,8 @@ class AdaDelta(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context),
-                zeros(weight.shape, weight.context))
+        return (_state_zeros(weight),
+                _state_zeros(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -327,10 +443,10 @@ class RMSProp(Optimizer):
 
     def create_state(self, index, weight):
         if self.centered:
-            return (zeros(weight.shape, weight.context, dtype="float32"),
-                    zeros(weight.shape, weight.context, dtype="float32"),
-                    zeros(weight.shape, weight.context, dtype="float32"))
-        return zeros(weight.shape, weight.context, dtype="float32")
+            return (_state_zeros(weight, dtype="float32"),
+                    _state_zeros(weight, dtype="float32"),
+                    _state_zeros(weight, dtype="float32"))
+        return _state_zeros(weight, dtype="float32")
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -352,8 +468,8 @@ class Ftrl(Optimizer):
         self.beta = beta
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype="float32"),
-                zeros(weight.shape, weight.context, dtype="float32"))
+        return (_state_zeros(weight, dtype="float32"),
+                _state_zeros(weight, dtype="float32"))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -372,9 +488,9 @@ class FTML(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype="float32"),
-                zeros(weight.shape, weight.context, dtype="float32"),
-                zeros(weight.shape, weight.context, dtype="float32"))
+        return (_state_zeros(weight, dtype="float32"),
+                _state_zeros(weight, dtype="float32"),
+                _state_zeros(weight, dtype="float32"))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -398,7 +514,7 @@ class DCASGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return (None, weight.copy())
-        return (zeros(weight.shape, weight.context), weight.copy())
+        return (_state_zeros(weight), weight.copy())
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -472,7 +588,7 @@ class LBSGD(SGD):
 @register
 class Test(Optimizer):
     def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context)
+        return _state_zeros(weight)
 
     def update(self, index, weight, grad, state):
         weight[:] = weight + grad * self.rescale_grad

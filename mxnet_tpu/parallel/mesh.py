@@ -18,9 +18,39 @@ import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
 __all__ = ["MeshContext", "get_mesh", "make_mesh", "data_parallel_mesh",
-           "PartitionSpec", "NamedSharding"]
+           "replicated_sharding", "batch_sharding", "PartitionSpec",
+           "NamedSharding"]
 
 _STATE = threading.local()
+
+# dp meshes built from device tuples, cached so every Parameter/batch over
+# the same device list shares ONE Mesh object (jit caches key on sharding)
+_DP_MESHES = {}
+
+
+def _dp_mesh_for(devices):
+    key = tuple(devices)
+    mesh = _DP_MESHES.get(key)
+    if mesh is None:
+        if len(set(key)) != len(key):
+            raise ValueError(
+                "duplicate devices in context list %s: SPMD data "
+                "parallelism needs one distinct device per entry"
+                % (list(devices),))
+        mesh = Mesh(np.asarray(list(devices)), ("dp",))
+        _DP_MESHES[key] = mesh
+    return mesh
+
+
+def replicated_sharding(devices):
+    """Replicated placement over a 'dp' mesh of `devices` (gluon Parameter
+    with a multi-device ctx list)."""
+    return NamedSharding(_dp_mesh_for(devices), PartitionSpec())
+
+
+def batch_sharding(devices):
+    """Leading-axis (batch) sharding over a 'dp' mesh of `devices`."""
+    return NamedSharding(_dp_mesh_for(devices), PartitionSpec("dp"))
 
 
 def make_mesh(axis_shapes, devices=None):

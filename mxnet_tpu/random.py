@@ -38,10 +38,19 @@ class _RandState(threading.local):
         if key is None:
             key = jax.random.PRNGKey(self.dev_seeds.get(dev, self.seed_val))
             if dev is not None:
-                key = jax.device_put(key, dev)
-                # decorrelate streams across devices (reference seeds each
-                # device sampler with seed ^ devid, random_generator.h)
-                key = jax.random.fold_in(key, dev.id)
+                if hasattr(dev, "device_set"):
+                    # a Sharding (SPMD executor): one REPLICATED chain whose
+                    # stream matches the lead device's single-device chain,
+                    # so an N-device run reproduces the 1-device trajectory
+                    lead = min(dev.device_set, key=lambda d: d.id)
+                    key = jax.random.fold_in(key, lead.id)
+                    key = jax.device_put(key, dev)
+                else:
+                    key = jax.device_put(key, dev)
+                    # decorrelate streams across devices (reference seeds
+                    # each device sampler with seed ^ devid,
+                    # random_generator.h)
+                    key = jax.random.fold_in(key, dev.id)
             self.keys[dev] = key
         return key
 

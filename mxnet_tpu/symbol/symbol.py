@@ -452,7 +452,11 @@ def create(op_name, input_syms, attrs, name=None):
             vnode = _Node(None, "%s_%s" % (name, suffix), {}, [])
             inputs.append((vnode, 0))
     node = _Node(op_name, name, node_attrs, inputs)
-    return Symbol([(node, i) for i in range(node.num_outputs)])
+    # only VISIBLE outputs participate in composition and executor outputs
+    # (reference FNumVisibleOutputs: BatchNorm's mean/var and Dropout's mask
+    # are internal); the hidden tail still exists on the node for eval
+    n_vis = get_op(op_name).n_visible(node_attrs)
+    return Symbol([(node, i) for i in range(n_vis)])
 
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
