@@ -13,11 +13,14 @@ TPU-native backends (SURVEY.md §2.7/§5 mapping):
 - ``tpu`` (alias ``nccl``) — same API; aggregation is laid out so that when
   values are sharded over a `parallel.Mesh`, the reduce lowers to `psum`
   over ICI (see `mxnet_tpu/parallel/`). This replaces `kvstore_nccl.h`.
-- ``dist_sync`` / ``dist_async`` / ``dist_sync_device`` — multi-process data
-  parallelism over `jax.distributed` collectives instead of the ps-lite
-  parameter server (`src/kvstore/kvstore_dist.h`). Sync mode is BSP like the
-  reference; async mode is emulated as sync (documented degradation — a
-  straggler-tolerant PS has no clean collective analog, SURVEY.md §5).
+- ``dist_sync`` / ``dist_sync_device`` — multi-process data parallelism
+  over `jax.distributed` collectives instead of the ps-lite parameter
+  server (`src/kvstore/kvstore_dist.h`). BSP like the reference.
+- ``dist_async`` — TRUE asynchronous parameter server (`AsyncKVStore` +
+  `parallel/ps_async.py`): update-on-push, no barrier, optional SSP
+  staleness bound — reference `kvstore_dist_server.h:282-294`. Requires a
+  server address (DMLC_PS_ROOT_URI / MXNET_PS_HOST); without one it
+  degrades to BSP sync (documented).
 
 The updater runs on-device as registered optimizer ops, which mirrors the
 reference running optimizer kernels inside the engine.
@@ -108,12 +111,24 @@ class KVStore:
             self._store[k] = vv
 
     def push(self, key, value, priority=0):
+        from .ndarray import sparse as _sp
         keys, values = _normalize(key, value)
         merged_list = []
         for k, vs in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
             vs = vs if isinstance(vs, list) else [vs]
+            if all(isinstance(v, _sp.RowSparseNDArray) and v.has_compact()
+                   for v in vs):
+                # compact row-sparse reduce: merge index sets + sum rows,
+                # O(sum nnz) — never densified (reference comm.h rsp
+                # reduce). Compression applies to dense pushes only, like
+                # the reference.
+                merged = vs[0]
+                for v in vs[1:]:
+                    merged = _sp.add_rows(merged, v)
+                merged_list.append(merged)
+                continue
             merged = _ctx_group_sum(vs)
             if self._gc is not None:
                 # reference compresses after the local device reduce, before
@@ -137,6 +152,12 @@ class KVStore:
         """Run the updater over pushed keys; a list push with the standard
         Updater applies every key in ONE compiled dispatch (FusedApplier),
         the analog of the reference's engine-bulked server updates."""
+        from . import optimizer as _opt
+        if any(_opt._is_lazy_rowsparse(g) for _, g, _ in batch):
+            # compact row-sparse grads take the per-key O(nnz) update path
+            for k, merged, stored in batch:
+                self._updater(k, merged, stored)
+            return
         if len(batch) > 1 and self._fused is not False:
             if self._fused is None:
                 self._fused = opt.FusedApplier.resolve(self._updater)
@@ -160,20 +181,32 @@ class KVStore:
                 src.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the requested rows (reference kvstore.h:195)."""
+        """Pull only the requested rows (reference kvstore.h:195-207).
+        A RowSparseNDArray `out` receives the COMPACT (rows, indices)
+        payload — only the live rows move; a dense `out` gets the rows
+        scattered into place."""
         if row_ids is None:
             return self.pull(key, out, priority)
+        from .ndarray import sparse as _sp
+        import numpy as _np
         keys, outs = _normalize(key, out)
         rids = row_ids if isinstance(row_ids, list) else [row_ids]
         for k, os in zip(keys, outs):
             src = self._store[k]
             os = os if isinstance(os, list) else [os]
             for o, rid in zip(os, rids * len(os)):
-                rows = src.take(rid.astype("int32"), axis=0)
+                rid_np = _np.unique(
+                    rid.asnumpy().astype(_np.int64)) \
+                    if isinstance(rid, NDArray) \
+                    else _np.unique(_np.asarray(rid, _np.int64))
+                rows = src._data[rid_np]  # gather: O(nnz) on the wire
+                if isinstance(o, _sp.RowSparseNDArray):
+                    o._aux = {"values": rows.astype(o.dtype),
+                              "indices": rid_np}
+                    o._dense = None
+                    continue
                 o[:] = 0
-                # scatter rows back into the dense output
-                o._data = o._data.at[rid._data.astype("int32")].set(
-                    rows._data.astype(o.dtype))
+                o._data = o._data.at[rid_np].set(rows.astype(o.dtype))
 
     # -- optimizer / updater --------------------------------------------
     def set_optimizer(self, optimizer):
@@ -212,9 +245,13 @@ class KVStore:
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Failure detection (reference kvstore.h:338 backed by ps-lite
-        heartbeats). Collectives have no heartbeat protocol: a dead peer
-        surfaces as a collective error/timeout instead, so a queryable
-        live cluster reports 0 dead nodes."""
+        heartbeats, van.cc). Multi-process stores count peers whose
+        heartbeat in the jax.distributed coordinator KV store is older
+        than ``timeout`` (see `parallel/dist.py:num_dead_nodes`);
+        single-process stores report 0."""
+        if self.type.startswith("dist"):
+            from .parallel import dist
+            return dist.num_dead_nodes(timeout)
         return 0
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
@@ -232,6 +269,81 @@ class KVStore:
         self._fused = None
 
 
+class AsyncKVStore(KVStore):
+    """True ``dist_async``: every push is applied on the parameter server
+    the moment it arrives and pulls return the current weight — no
+    aggregation barrier, so a straggling worker never blocks the others
+    (reference `src/kvstore/kvstore_dist_server.h:282-294`). Backed by
+    `parallel/ps_async.py` (host TCP server, the ps-lite analog); the
+    server address comes from ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``
+    (reference launcher env) or ``MXNET_PS_HOST``/``MXNET_PS_PORT``."""
+
+    def __init__(self):
+        super().__init__("dist_async")
+        import os
+        from .parallel.ps_async import AsyncPSClient
+        host = os.environ.get("DMLC_PS_ROOT_URI",
+                              os.environ.get("MXNET_PS_HOST", "127.0.0.1"))
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT",
+                                  os.environ.get("MXNET_PS_PORT", "9090")))
+        rank = int(os.environ.get("DMLC_WORKER_ID",
+                                  os.environ.get("MXNET_PS_RANK", "0")))
+        self._n_workers = int(os.environ.get("DMLC_NUM_WORKER",
+                                             os.environ.get(
+                                                 "MXNET_PS_NUM_WORKERS",
+                                                 "1")))
+        self._client = AsyncPSClient((host, port), rank=rank)
+        self._rank = rank
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._n_workers
+
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._check_key(k)
+            vlist = v if isinstance(v, list) else [v]
+            self._client.init(k, vlist[0].asnumpy())
+            # first writer wins on the server; every worker starts from
+            # the server's value (reference InitImpl semantics)
+            srv = self._client.pull(k)
+            for dst in vlist:
+                dst[:] = srv
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, vs in zip(keys, values):
+            vs = vs if isinstance(vs, list) else [vs]
+            merged = _ctx_group_sum(vs)
+            # ship and return: the server updates on receipt; no barrier
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, os_ in zip(keys, outs):
+            os_ = os_ if isinstance(os_, list) else [os_]
+            val = self._client.pull(k)
+            for o in os_:
+                o[:] = val
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._client.set_optimizer(optimizer)
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        return self._client.num_dead_node(node_id, timeout)
+
+    def barrier(self):
+        """Async mode has no training barrier; kept as heartbeat ping."""
+        self._client.heartbeat()
+
+
 def _normalize(key, value):
     if isinstance(key, (str, int)):
         return [key], [value]
@@ -240,6 +352,7 @@ def _normalize(key, value):
 
 def create(name="local"):
     """Factory (reference `src/kvstore/kvstore.cc:40-75`)."""
+    import os
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "nccl", "tpu", "local_allreduce_cpu",
@@ -247,4 +360,9 @@ def create(name="local"):
              "dist_sync_device", "dist_device_sync", "dist")
     if name not in valid:
         raise MXNetError("unknown kvstore type %s" % name)
+    if name == "dist_async" and (
+            "DMLC_PS_ROOT_URI" in os.environ or
+            "MXNET_PS_HOST" in os.environ):
+        return AsyncKVStore()
+    # dist_async without a PS address degrades to BSP sync (documented)
     return KVStore(name)

@@ -1,36 +1,103 @@
-"""Sparse NDArrays: RowSparse and CSR.
+"""Sparse NDArrays: RowSparse and CSR with COMPACT storage.
 
 Parity with reference `python/mxnet/ndarray/sparse.py` and the C++ storage
-types (`include/mxnet/ndarray.h:61-66`). TPU note (SURVEY.md §7 hard-part 3):
-TPUs have no native sparse kernels — aux index structures live as dense
-int arrays and sparse math lowers to gather/scatter + dense MXU ops, which is
-the idiomatic XLA formulation. The API (stype, indices/indptr/data,
-cast_storage, sparse dot, retain) matches the reference.
+types (`include/mxnet/ndarray.h:61-66,228-278`). The payload is the compact
+structure itself — `(data[nnz,...], indices[nnz])` for row_sparse,
+`(data[nnz], indices[nnz], indptr[rows+1])` for CSR — exactly like the
+reference's aux_data arrays, so memory scales with nnz, not the dense shape.
+
+TPU note (SURVEY.md §7 hard-part 3): TPUs have no native sparse kernels, so
+sparse COMPUTE lowers to gather/scatter + dense MXU ops over the compact
+arrays (the idiomatic XLA formulation; `tests/test_sparse.py` asserts the
+O(nnz) economics). A dense view is materialized lazily — only when an op
+that has no compact path touches `._data` — and cached; in-place writes to
+the dense view invalidate the compact form, which is then recomputed
+vectorized (no Python-per-row loops, the round-2 review finding).
 """
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
-from ..base import MXNetError, dtype_np
+from ..base import MXNetError, dtype_np, device_of
 from ..context import current_context
 from .ndarray import NDArray, array as nd_array, zeros as nd_zeros
 
 __all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
            "csr_matrix", "row_sparse_array", "cast_storage", "zeros", "empty",
-           "retain", "dot"]
+           "retain", "dot", "add_rows"]
 
 
 class BaseSparseNDArray(NDArray):
-    """Sparse wrapper: keeps the dense payload (for compute) plus the sparse
-    aux structure (for IO/comm); `_data` stays the dense jax array so every
-    registered op works unchanged."""
+    """Compact-first sparse array. Exactly one of (compact aux, dense cache)
+    is authoritative at any time:
 
-    __slots__ = ("_aux",)
+    - built sparse: aux holds the compact payload; `._data` materializes
+      (scatters) a dense jax array on first touch and caches it.
+    - mutated dense (`x[:] = ...`, op `out=` rebinding): the cache becomes
+      authoritative and the compact form is recomputed lazily, vectorized.
+    """
 
-    def __init__(self, data, ctx=None, aux=None):
-        super().__init__(data, ctx)
-        self._aux = aux or {}
+    # NOTE: deliberately NOT adding '_data' here — the property below
+    # shadows NDArray's slot descriptor.
+    __slots__ = ("_aux", "_dense")
+
+    def __init__(self, data, ctx=None, aux=None, shape=None, dtype=None):
+        self._aux = dict(aux) if aux else None
+        self._dense = None
+        self._shape = None
+        self._dtype = None
+        if data is None:
+            assert aux is not None and shape is not None
+            self._shape = tuple(shape)
+            self._dtype = np.dtype(dtype or aux["values"].dtype)
+            super().__init__(None, ctx)
+        else:
+            super().__init__(data, ctx)
+
+    # -- dense view (lazy) ------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense is None:
+            self._dense = self._materialize()
+        return self._dense
+
+    @_data.setter
+    def _data(self, v):
+        self._dense = v
+        if v is not None:
+            self._aux = None  # compact form stale; recomputed on demand
+            self._shape = tuple(v.shape)
+            self._dtype = np.dtype(v.dtype)
+
+    @property
+    def shape(self):
+        return self._shape if self._dense is None else tuple(self._dense.shape)
+
+    @property
+    def dtype(self):
+        return self._dtype if self._dense is None else np.dtype(self._dense.dtype)
+
+    def _materialize(self):
+        raise NotImplementedError
+
+    def _ensure_aux(self):
+        if self._aux is None:
+            self._aux = self._compact_from_dense(self._dense)
+        return self._aux
+
+    def _compact_from_dense(self, dense):
+        raise NotImplementedError
+
+    def has_compact(self):
+        """True while the compact payload is authoritative (no dense copy
+        has been materialized) — the state sparse optimizers fast-path on."""
+        return self._aux is not None
+
+    @property
+    def nnz(self):
+        return int(self._ensure_aux()["values"].shape[0])
 
     def __repr__(self):
         return "\n%s\n<%s %s @%s>" % (str(self.asnumpy()),
@@ -50,15 +117,35 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
-        return nd_array(self._aux["indices"], dtype=np.int64)
+        return nd_array(np.asarray(self._ensure_aux()["indices"]),
+                        dtype=np.int64)
 
     @property
     def indptr(self):
-        return nd_array(self._aux["indptr"], dtype=np.int64)
+        return nd_array(np.asarray(self._ensure_aux()["indptr"]),
+                        dtype=np.int64)
 
     @property
     def data(self):
-        return nd_array(self._aux["values"])
+        return nd_array(np.asarray(self._ensure_aux()["values"]))
+
+    def _materialize(self):
+        aux = self._aux
+        vals = np.asarray(aux["values"])
+        idx = np.asarray(aux["indices"])
+        indptr = np.asarray(aux["indptr"])
+        rows = np.repeat(np.arange(self._shape[0]), np.diff(indptr))
+        dense = np.zeros(self._shape, self._dtype)
+        dense[rows, idx] = vals
+        return jnp.asarray(dense)
+
+    def _compact_from_dense(self, dense):
+        d = np.asarray(dense)
+        rows, cols = np.nonzero(d)
+        indptr = np.zeros(d.shape[0] + 1, np.int64)
+        np.cumsum(np.bincount(rows, minlength=d.shape[0]), out=indptr[1:])
+        return {"values": d[rows, cols], "indices": cols.astype(np.int64),
+                "indptr": indptr}
 
     def tostype(self, stype):
         return cast_storage(self, stype)
@@ -71,11 +158,29 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
-        return nd_array(self._aux["indices"], dtype=np.int64)
+        return nd_array(np.asarray(self._ensure_aux()["indices"]),
+                        dtype=np.int64)
 
     @property
     def data(self):
-        return nd_array(self._aux["values"])
+        return nd_array(np.asarray(self._ensure_aux()["values"]))
+
+    def compact(self):
+        """(values, indices) as device arrays — the O(nnz) compute payload."""
+        aux = self._ensure_aux()
+        return jnp.asarray(aux["values"]), jnp.asarray(aux["indices"])
+
+    def _materialize(self):
+        vals, idx = self.compact()
+        dense = jnp.zeros(self._shape, self._dtype)
+        if vals.shape[0]:
+            dense = dense.at[idx].set(vals.astype(self._dtype))
+        return dense
+
+    def _compact_from_dense(self, dense):
+        d = np.asarray(dense)
+        nz = np.nonzero(np.any(d.reshape(d.shape[0], -1) != 0, axis=1))[0]
+        return {"values": d[nz], "indices": nz.astype(np.int64)}
 
     def tostype(self, stype):
         return cast_storage(self, stype)
@@ -91,20 +196,20 @@ def _dense_np(x):
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    """Create CSRNDArray from (data, indices, indptr) or dense source."""
+    """Create CSRNDArray from (data, indices, indptr) or dense source.
+    The compact triple IS the storage; no dense buffer is allocated."""
     dtype = dtype_np(dtype) if dtype else None
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         data = _dense_np(data)
-        indices = _dense_np(indices).astype(np.int64)
-        indptr = _dense_np(indptr).astype(np.int64)
+        if dtype:
+            data = data.astype(dtype)
+        aux = {"values": data,
+               "indices": _dense_np(indices).astype(np.int64),
+               "indptr": _dense_np(indptr).astype(np.int64)}
         assert shape is not None
-        dense = np.zeros(shape, dtype=dtype or data.dtype)
-        for r in range(shape[0]):
-            for k in range(indptr[r], indptr[r + 1]):
-                dense[r, indices[k]] = data[k]
-        return CSRNDArray(jnp.asarray(dense), ctx or current_context(),
-                          {"values": data, "indices": indices, "indptr": indptr})
+        return CSRNDArray(None, ctx or current_context(), aux,
+                          shape=shape, dtype=data.dtype)
     dense = _dense_np(arg1)
     if dtype:
         dense = dense.astype(dtype)
@@ -112,18 +217,13 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
 
 
 def _dense_to_csr(dense, ctx=None):
-    indptr = [0]
-    indices = []
-    values = []
-    for row in dense:
-        nz = np.nonzero(row)[0]
-        indices.extend(nz.tolist())
-        values.extend(row[nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(jnp.asarray(dense), ctx or current_context(),
-                      {"values": np.asarray(values, dense.dtype),
-                       "indices": np.asarray(indices, np.int64),
-                       "indptr": np.asarray(indptr, np.int64)})
+    rows, cols = np.nonzero(dense)
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.cumsum(np.bincount(rows, minlength=dense.shape[0]), out=indptr[1:])
+    aux = {"values": dense[rows, cols], "indices": cols.astype(np.int64),
+           "indptr": indptr}
+    return CSRNDArray(None, ctx or current_context(), aux,
+                      shape=dense.shape, dtype=dense.dtype)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -131,12 +231,13 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
         data = _dense_np(data)
-        indices = _dense_np(indices).astype(np.int64)
+        if dtype:
+            data = data.astype(dtype)
+        aux = {"values": data,
+               "indices": _dense_np(indices).astype(np.int64)}
         assert shape is not None
-        dense = np.zeros(shape, dtype=dtype or data.dtype)
-        dense[indices] = data
-        return RowSparseNDArray(jnp.asarray(dense), ctx or current_context(),
-                                {"values": data, "indices": indices})
+        return RowSparseNDArray(None, ctx or current_context(), aux,
+                                shape=shape, dtype=data.dtype)
     dense = _dense_np(arg1)
     if dtype:
         dense = dense.astype(dtype)
@@ -144,19 +245,27 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
 
 
 def _dense_to_row_sparse(dense, ctx=None):
-    nz_rows = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
-    return RowSparseNDArray(jnp.asarray(dense), ctx or current_context(),
-                            {"values": dense[nz_rows],
-                             "indices": nz_rows.astype(np.int64)})
+    nz = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    aux = {"values": dense[nz], "indices": nz.astype(np.int64)}
+    return RowSparseNDArray(None, ctx or current_context(), aux,
+                            shape=dense.shape, dtype=dense.dtype)
 
 
 def cast_storage(arr, stype):
-    """Reference `tensor/cast_storage-inl.h` dense<->sparse conversion."""
+    """Reference `tensor/cast_storage-inl.h` dense<->sparse conversion,
+    vectorized (numpy nonzero/bincount — no per-row Python loops)."""
     if stype == arr.stype:
         return arr
-    dense = arr.asnumpy()
     if stype == "default":
-        return NDArray(jnp.asarray(dense), arr.ctx)
+        return NDArray(arr._data, arr.ctx)
+    if isinstance(arr, BaseSparseNDArray) and arr.has_compact():
+        if isinstance(arr, RowSparseNDArray) and stype == "csr":
+            aux = arr._ensure_aux()
+            return _dense_to_csr(np.asarray(arr._data), arr.ctx) \
+                if arr.ndim != 2 else _rs_to_csr(aux, arr.shape, arr.ctx)
+        if isinstance(arr, CSRNDArray) and stype == "row_sparse":
+            return _csr_to_rs(arr._ensure_aux(), arr.shape, arr.ctx)
+    dense = arr.asnumpy()
     if stype == "csr":
         return _dense_to_csr(dense, arr.ctx)
     if stype == "row_sparse":
@@ -164,13 +273,60 @@ def cast_storage(arr, stype):
     raise MXNetError("unknown storage type " + stype)
 
 
+def _rs_to_csr(aux, shape, ctx):
+    """row_sparse -> csr without densifying: expand each stored row.
+    Stored rows may be in any index order; CSR is ordered by dense row id,
+    so sort first."""
+    vals = np.asarray(aux["values"])
+    ridx = np.asarray(aux["indices"])
+    order = np.argsort(ridx)
+    vals, ridx = vals[order], ridx[order]
+    counts = np.zeros(shape[0], np.int64)
+    nz_r, nz_c = np.nonzero(vals)
+    counts[ridx] = np.bincount(nz_r, minlength=vals.shape[0])
+    indptr = np.zeros(shape[0] + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return CSRNDArray(None, ctx,
+                      {"values": vals[nz_r, nz_c],
+                       "indices": nz_c.astype(np.int64), "indptr": indptr},
+                      shape=shape, dtype=vals.dtype)
+
+
+def _csr_to_rs(aux, shape, ctx):
+    vals = np.asarray(aux["values"])
+    cols = np.asarray(aux["indices"])
+    indptr = np.asarray(aux["indptr"])
+    counts = np.diff(indptr)
+    nz_rows = np.nonzero(counts)[0]
+    out = np.zeros((len(nz_rows),) + tuple(shape[1:]), vals.dtype)
+    rows = np.repeat(np.arange(shape[0]), counts)
+    remap = np.zeros(shape[0], np.int64)
+    remap[nz_rows] = np.arange(len(nz_rows))
+    out[remap[rows], cols] = vals
+    return RowSparseNDArray(None, ctx,
+                            {"values": out,
+                             "indices": nz_rows.astype(np.int64)},
+                            shape=shape, dtype=vals.dtype)
+
+
 def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "default":
         return nd_zeros(shape, ctx=ctx, dtype=dtype)
-    base = np.zeros(shape, dtype_np(dtype))
+    dtype = dtype_np(dtype) if dtype else np.float32
+    ctx = ctx or current_context()
     if stype == "csr":
-        return _dense_to_csr(base, ctx)
-    return _dense_to_row_sparse(base, ctx)
+        return CSRNDArray(None, ctx,
+                          {"values": np.zeros((0,), dtype),
+                           "indices": np.zeros((0,), np.int64),
+                           "indptr": np.zeros(shape[0] + 1, np.int64)},
+                          shape=shape, dtype=dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(None, ctx,
+                                {"values": np.zeros((0,) + tuple(shape[1:]),
+                                                    dtype),
+                                 "indices": np.zeros((0,), np.int64)},
+                                shape=shape, dtype=dtype)
+    raise MXNetError("unknown storage type " + stype)
 
 
 def empty(stype, shape, ctx=None, dtype=None):
@@ -178,18 +334,54 @@ def empty(stype, shape, ctx=None, dtype=None):
 
 
 def retain(arr, row_ids):
-    """Reference sparse_retain: keep only the given rows."""
+    """Reference sparse_retain: keep only the given rows — O(nnz) over the
+    compact payload, never densified."""
     rid = row_ids.asnumpy().astype(np.int64) if isinstance(row_ids, NDArray) \
         else np.asarray(row_ids, np.int64)
-    dense = arr.asnumpy()
-    out = np.zeros_like(dense)
-    out[rid] = dense[rid]
-    return _dense_to_row_sparse(out, arr.ctx)
+    aux = arr._ensure_aux()
+    idx = np.asarray(aux["indices"])
+    keep = np.isin(idx, rid)
+    return RowSparseNDArray(None, arr.ctx,
+                            {"values": np.asarray(aux["values"])[keep],
+                             "indices": idx[keep]},
+                            shape=arr.shape, dtype=arr.dtype)
+
+
+def add_rows(a, b):
+    """row_sparse + row_sparse -> row_sparse, O(nnz_a + nnz_b): merge the
+    index sets and sum duplicate rows (reference ElemwiseBinaryOp rsp+rsp,
+    elemwise_binary_op-inl.h)."""
+    aa, ab = a._ensure_aux(), b._ensure_aux()
+    ia, ib = np.asarray(aa["indices"]), np.asarray(ab["indices"])
+    va, vb = np.asarray(aa["values"]), np.asarray(ab["values"])
+    merged, inv = np.unique(np.concatenate([ia, ib]), return_inverse=True)
+    out = np.zeros((len(merged),) + va.shape[1:],
+                   np.promote_types(va.dtype, vb.dtype))
+    np.add.at(out, inv[:len(ia)], va)
+    np.add.at(out, inv[len(ia):], vb)
+    return RowSparseNDArray(None, a.ctx,
+                            {"values": out, "indices": merged},
+                            shape=a.shape, dtype=out.dtype)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse-aware dot (reference tensor/dot-inl.h): lowers to dense MXU
-    matmul — on TPU the dense path through gather is the fast one."""
+    """Sparse-aware dot (reference tensor/dot-inl.h). CSR x dense runs
+    O(nnz * cols) over the compact payload: gather the needed rhs rows and
+    segment-sum into output rows — gather + MXU-friendly math, no dense lhs.
+    Other combinations fall back to the dense path."""
+    if isinstance(lhs, CSRNDArray) and lhs.has_compact() and \
+            not transpose_a and not transpose_b and \
+            isinstance(rhs, NDArray) and rhs.ndim == 2:
+        aux = lhs._ensure_aux()
+        vals = jnp.asarray(aux["values"])
+        cols = jnp.asarray(aux["indices"])
+        indptr = np.asarray(aux["indptr"])
+        rows = jnp.asarray(np.repeat(np.arange(lhs.shape[0]),
+                                     np.diff(indptr)))
+        gathered = rhs._data[cols] * vals[:, None].astype(rhs.dtype)
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=lhs.shape[0])
+        return NDArray(out.astype(rhs.dtype), lhs.ctx)
     from ..ops.invoke import invoke
     return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a,
                                       "transpose_b": transpose_b})

@@ -100,3 +100,154 @@ def test_sparse_sgd_semantics():
     nw = new_w.asnumpy()
     np.testing.assert_allclose(nw[1], 1.0)   # untouched row
     assert (nw[0] < 1.0).all() and (nw[2] < 1.0).all()
+
+
+# ---------------------------------------------------------------------------
+# Compact-storage economics (round-2 verdict #3): memory and update cost
+# must scale with nnz, not the dense shape.
+# ---------------------------------------------------------------------------
+
+def test_compact_storage_never_densifies():
+    """A (1M, 64) row-sparse array with 8 live rows stores 8 rows."""
+    rows = 1_000_000
+    vals = np.ones((8, 64), "f")
+    idx = np.array([3, 77, 1000, 5000, 99999, 500000, 700000, 999999])
+    rs = sparse.row_sparse_array((vals, idx), shape=(rows, 64))
+    assert rs.has_compact() and rs.nnz == 8
+    assert rs._dense is None  # no dense buffer was ever allocated
+    kept = rs.retain(mx.nd.array(np.array([77.0, 500000.0], "f")))
+    assert kept.nnz == 2 and kept._dense is None
+    np.testing.assert_allclose(kept.data.asnumpy(), np.ones((2, 64)))
+    # csr <-> rs conversions stay compact too
+    z = sparse.zeros("row_sparse", (rows, 64))
+    assert z.nnz == 0 and z._dense is None
+
+
+def test_sparse_dot_stays_compact():
+    csr = sparse.csr_matrix(DENSE)
+    assert csr.has_compact()
+    rhs = np.random.RandomState(0).rand(3, 5).astype("f")
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), DENSE.dot(rhs), rtol=1e-5)
+    assert csr._dense is None  # the O(nnz) path never densified the lhs
+
+
+def test_sparse_sgd_update_cost_scales_with_nnz():
+    """The compiled sparse-update program's operand shapes are O(nnz): the
+    jit cache key buckets on padded nnz, and a 1M-row weight update with
+    nnz=8 compiles a bucket-8 program, not a 1M-row one."""
+    from mxnet_tpu import optimizer as opt_mod
+    rows = 1_000_000
+    w = mx.nd.ones((rows, 4))
+    vals = np.full((8, 4), 2.0, "f")
+    idx = np.array([0, 5, 100, 1000, 65536, 99999, 500000, 999999])
+    g = sparse.row_sparse_array((vals, idx), shape=(rows, 4))
+    opt = opt_mod.SGD(learning_rate=0.5, momentum=0.9, rescale_grad=1.0)
+    state = opt.create_state(0, w)
+    opt_mod._SPARSE_ROW_JIT.clear()
+    opt.update(0, w, g, state)
+    keys = list(opt_mod._SPARSE_ROW_JIT)
+    assert len(keys) == 1
+    kind, shape, dtype, bucket, _ = keys[0]
+    assert kind == "sgd_mom" and bucket == 8  # operand rows = nnz, not 1M
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[idx], 1.0 - 0.5 * 2.0)  # touched rows
+    untouched = [1, 4, 99, 12345, 999998]
+    np.testing.assert_allclose(out[untouched], 1.0)
+    # momentum state touched only on live rows
+    st = state.asnumpy()
+    np.testing.assert_allclose(st[idx], 2.0)
+    np.testing.assert_allclose(st[untouched], 0.0)
+
+
+def test_sparse_adam_matches_dense_on_live_rows():
+    from mxnet_tpu import optimizer as opt_mod
+    rng = np.random.RandomState(0)
+    wv = rng.rand(50, 3).astype("f")
+    gv = np.zeros((50, 3), "f")
+    live = np.array([2, 7, 31])
+    gv[live] = rng.rand(3, 3)
+
+    # dense reference
+    wd_ = mx.nd.array(wv)
+    opt_d = opt_mod.Adam(learning_rate=0.1, rescale_grad=1.0,
+                         lazy_update=False)
+    st_d = opt_d.create_state(0, wd_)
+    opt_d.update(0, wd_, mx.nd.array(gv), st_d)
+
+    # sparse lazy path
+    ws = mx.nd.array(wv)
+    opt_s = opt_mod.Adam(learning_rate=0.1, rescale_grad=1.0)
+    st_s = opt_s.create_state(0, ws)
+    g_rs = sparse.row_sparse_array((gv[live], live), shape=(50, 3))
+    opt_s.update(0, ws, g_rs, st_s)
+
+    np.testing.assert_allclose(ws.asnumpy()[live], wd_.asnumpy()[live],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ws.asnumpy()[~np.isin(np.arange(50), live)],
+                               wv[~np.isin(np.arange(50), live)])
+
+
+def test_rowsparse_pull_moves_compact_payload():
+    kv = mx.kv.create("local")
+    big = np.zeros((10000, 16), "f")
+    big[7] = 1.0
+    big[42] = 2.0
+    big[9999] = 3.0
+    kv.init("emb", mx.nd.array(big))
+    out = sparse.zeros("row_sparse", (10000, 16))
+    rid = mx.nd.array(np.array([7, 9999], "f"))
+    kv.row_sparse_pull("emb", out=out, row_ids=rid)
+    assert out.has_compact() and out.nnz == 2  # only live rows moved
+    assert out._dense is None
+    np.testing.assert_allclose(out.data.asnumpy()[0], 1.0)
+    np.testing.assert_allclose(out.data.asnumpy()[1], 3.0)
+
+
+def test_push_merges_compact_rowsparse():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((100, 2)))
+    a = sparse.row_sparse_array((np.ones((2, 2), "f"), np.array([1, 50])),
+                                shape=(100, 2))
+    b = sparse.row_sparse_array((np.ones((2, 2), "f"), np.array([50, 99])),
+                                shape=(100, 2))
+    kv.push("w", [a, b])
+    out = mx.nd.zeros((100, 2))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], 1.0)
+    np.testing.assert_allclose(got[50], 2.0)  # duplicate rows summed
+    np.testing.assert_allclose(got[99], 1.0)
+    np.testing.assert_allclose(got[0], 0.0)
+
+
+def test_cast_storage_rs_csr_compact():
+    rs = sparse.row_sparse_array(DENSE)
+    csr = sparse.cast_storage(rs, "csr")
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3, 3, 4])
+    np.testing.assert_allclose(csr.indices.asnumpy(), [1, 0, 2, 0])
+    np.testing.assert_allclose(csr.data.asnumpy(), [1, 2, 3, 4])
+    back = sparse.cast_storage(csr, "row_sparse")
+    np.testing.assert_allclose(back.indices.asnumpy(), [0, 1, 3])
+    np.testing.assert_allclose(back.todense().asnumpy(), DENSE)
+
+
+def test_dense_mutation_invalidates_compact():
+    rs = sparse.row_sparse_array(DENSE)
+    rs[:] = np.ones_like(DENSE)
+    np.testing.assert_allclose(rs.todense().asnumpy(), 1.0)
+    # compact form recomputed from the mutated dense, vectorized
+    assert rs.nnz == 4
+    np.testing.assert_allclose(rs.indices.asnumpy(), [0, 1, 2, 3])
+
+
+def test_cast_storage_rs_csr_unsorted_indices():
+    """Stored rows in arbitrary index order must land at their dense row
+    ids in CSR (review r3 finding)."""
+    vals = np.array([[1, 1], [2, 2]], "f")
+    rs = sparse.row_sparse_array((vals, np.array([3, 0])), shape=(5, 2))
+    csr = sparse.cast_storage(rs, "csr")
+    np.testing.assert_allclose(csr.todense().asnumpy(),
+                               rs.todense().asnumpy())
+    np.testing.assert_allclose(csr.todense().asnumpy()[0], 2.0)
+    np.testing.assert_allclose(csr.todense().asnumpy()[3], 1.0)
