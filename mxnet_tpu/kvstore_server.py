@@ -29,18 +29,30 @@ class KVStoreServer:
         return server_controller
 
     def run(self):
-        """Block like a PS server would: join the collective cluster and
-        barrier until the workers' run completes."""
+        """Serve. For dist_async this hosts the REAL parameter server
+        (`parallel/ps_async.serve_forever`, update-on-push) until a stop
+        command; for sync modes it joins the collective cluster and
+        barriers until the workers finish."""
+        if "async" in getattr(self.kvstore, "type", ""):
+            from .parallel import ps_async
+            host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+            port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
+            staleness = os.environ.get("MXNET_ASYNC_STALENESS")
+            srv, _ = ps_async.serve_forever(
+                (host, port),
+                staleness=int(staleness) if staleness else None)
+            srv._thread.join()  # until a ("stop",) frame shuts it down
+            return
         from .parallel import dist
         dist.init()
         dist.barrier()
 
 
 def _init_kvstore_server_module():
-    """Reference entry: start a server when DMLC_ROLE=server. Collective
-    backends have no server role; worker/scheduler roles return."""
+    """Reference entry: start a server when DMLC_ROLE=server."""
     role = os.environ.get("DMLC_ROLE", "worker")
     if role == "server":
         from . import kvstore
-        server = KVStoreServer(kvstore.create("dist_sync"))
+        mode = os.environ.get("MXNET_KVSTORE_MODE", "dist_sync")
+        server = KVStoreServer(kvstore.KVStore(mode))
         server.run()

@@ -24,11 +24,15 @@ import numpy as np
 import jax
 
 __all__ = ["init", "allreduce_nd", "allreduce_nds", "broadcast_nd",
-           "barrier", "rank", "size"]
+           "barrier", "rank", "size", "start_heartbeat", "stop_heartbeat",
+           "num_dead_nodes"]
 
 _initialized = False
 _PMESH = None
 _AR_JIT = {}
+_HB_THREAD = None
+_HB_STOP = None
+_HB_PREFIX = "mxnet_tpu_hb"
 
 
 def init(coordinator_address=None, num_processes=None, process_id=None):
@@ -45,6 +49,12 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
     if coordinator_address:
         jax.distributed.initialize(coordinator_address, num_processes, process_id)
     _initialized = True
+    # liveness protocol on by default for multi-process runs (reference
+    # ps-lite heartbeats are always on, van.cc); cheap: one tiny KV write
+    # per interval
+    if jax.process_count() > 1:
+        start_heartbeat(float(os.environ.get(
+            "MXNET_HEARTBEAT_INTERVAL", "5")))
 
 
 def rank():
@@ -139,3 +149,95 @@ def barrier():
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
+
+
+# ---------------------------------------------------------------------------
+# Liveness / failure detection (reference kvstore.h:338 get_num_dead_node,
+# backed by ps-lite heartbeats between nodes and the scheduler, van.cc).
+# Here each process heartbeats a timestamp into the jax.distributed
+# coordinator's key-value store; any process can count peers whose beat is
+# older than a timeout.
+# ---------------------------------------------------------------------------
+
+def _coordinator_client():
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover
+        return None
+
+
+def start_heartbeat(interval=5.0):
+    """Background thread writing this process's liveness timestamp to the
+    coordinator KV store every ``interval`` seconds. No-op single-process
+    or when no coordinator is attached."""
+    global _HB_THREAD, _HB_STOP
+    client = _coordinator_client()
+    if client is None or _HB_THREAD is not None:
+        return False
+    import threading
+    import time as _time
+
+    _HB_STOP = threading.Event()
+    me = jax.process_index()
+
+    def beat():
+        while True:
+            try:
+                client.key_value_set("%s/%d" % (_HB_PREFIX, me),
+                                     repr(_time.time()),
+                                     allow_overwrite=True)
+            except Exception:  # pragma: no cover - coordinator gone
+                return
+            if _HB_STOP.wait(interval):
+                return
+
+    _HB_THREAD = threading.Thread(target=beat, daemon=True,
+                                  name="mxnet_tpu-heartbeat")
+    _HB_THREAD.start()
+    return True
+
+
+def stop_heartbeat():
+    global _HB_THREAD, _HB_STOP
+    if _HB_STOP is not None:
+        _HB_STOP.set()
+    _HB_THREAD = None
+    _HB_STOP = None
+
+
+def num_dead_nodes(timeout=60):
+    """Count processes whose heartbeat is older than ``timeout`` seconds
+    (or missing entirely). Returns 0 when not distributed or when no peer
+    ever started heartbeating (no liveness protocol in play)."""
+    client = _coordinator_client()
+    if client is None or jax.process_count() == 1:
+        return 0
+    import time as _time
+    try:
+        entries = client.key_value_dir_get(_HB_PREFIX)
+    except Exception:
+        return 0
+    if not entries:
+        return 0
+    now = _time.time()
+    seen = {}
+    for k, v in entries:
+        try:
+            seen[int(str(k).rsplit("/", 1)[-1])] = float(str(v))
+        except ValueError:  # pragma: no cover
+            continue
+    if not seen:
+        return 0
+    # a peer with NO key yet may simply still be starting up: only count
+    # missing peers once the cluster has been beating for > timeout
+    # (earliest observed beat as the cluster-age proxy)
+    cluster_old_enough = now - min(seen.values()) > timeout
+    dead = 0
+    for pid in range(jax.process_count()):
+        t = seen.get(pid)
+        if t is None:
+            dead += 1 if cluster_old_enough else 0
+        elif now - t > timeout:
+            dead += 1
+    return dead

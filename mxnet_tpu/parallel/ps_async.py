@@ -1,0 +1,237 @@
+"""Asynchronous parameter server — true ``dist_async``.
+
+Reference `src/kvstore/kvstore_dist_server.h:282-294` (`DataHandleDefault`,
+async branch): the server applies each worker's pushed gradient to the
+stored weight IMMEDIATELY — `exec_.Exec(updater)` on receipt — and pulls
+return whatever the weight currently is. No barrier, no aggregation across
+workers: a straggler never blocks the fast workers (SSP/Hogwild-style data
+parallelism).
+
+TPU-native placement: the synchronous path rides XLA collectives over
+ICI/DCN (`parallel/dist.py`) because BSP maps onto them perfectly; async
+does NOT — a straggler-tolerant server needs point-to-point push/pull with
+server-side state, which collectives cannot express. So the async store is
+a host-side TCP server (the reference's ps-lite is likewise host TCP/RDMA,
+van.cc) holding numpy weights; each worker's device keeps training and only
+its own push/pull crosses the host boundary.
+
+Optional bounded staleness (`MXNET_ASYNC_STALENESS=S`): a worker's push
+blocks only while it is more than S pushes ahead of the slowest worker on
+that key (SSP). Unset = unbounded, the reference's pure-async semantics.
+
+Wire protocol (length-prefixed pickle frames over TCP):
+    ("init", key, ndarray)          -> ("ok",)      first writer wins
+    ("push", key, ndarray, rank)    -> ("ok",)      update-on-receive
+    ("pull", key)                   -> ("val", ndarray)
+    ("set_optimizer", bytes)        -> ("ok",)      pickled Optimizer
+    ("num_dead", node_id, timeout)  -> ("n", int)   heartbeat-based
+    ("heartbeat", rank)             -> ("ok",)
+    ("stop",)                       -> ("ok",)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["AsyncPSServer", "AsyncPSClient", "serve_forever"]
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class AsyncPSServer:
+    """In-process async PS: per-key lock, update-on-push."""
+
+    def __init__(self, staleness=None):
+        self.store = {}            # key -> np.ndarray (current weight)
+        self.locks = {}            # key -> threading.Lock
+        self.push_counts = {}      # key -> {rank: count}
+        self.optimizer = None
+        self.updater = None
+        self.states = {}           # key -> optimizer state (np arrays)
+        self.heartbeats = {}       # rank -> last monotonic time
+        self.staleness = staleness
+        self._global_lock = threading.Lock()
+        self._cv = threading.Condition(self._global_lock)
+
+    # -- handlers --------------------------------------------------------
+    def handle(self, msg):
+        op = msg[0]
+        if op == "init":
+            _, key, val = msg
+            with self._global_lock:
+                if key not in self.store:   # first writer wins (reference
+                    self.store[key] = np.array(val)   # InitImpl)
+                    self.locks[key] = threading.Lock()
+                    self.push_counts[key] = {}
+            return ("ok",)
+        if op == "push":
+            _, key, grad, rank = msg
+            self._maybe_wait_staleness(key, rank)
+            with self.locks[key]:
+                self._apply(key, np.asarray(grad))
+            with self._cv:
+                counts = self.push_counts[key]
+                counts[rank] = counts.get(rank, 0) + 1
+                self._cv.notify_all()
+            return ("ok",)
+        if op == "pull":
+            _, key = msg
+            with self.locks[key]:
+                return ("val", self.store[key].copy())
+        if op == "set_optimizer":
+            from .. import optimizer as opt
+            self.optimizer = pickle.loads(msg[1])
+            self.updater = opt.get_updater(self.optimizer)
+            return ("ok",)
+        if op == "heartbeat":
+            self.heartbeats[msg[1]] = time.monotonic()
+            return ("ok",)
+        if op == "num_dead":
+            _, _node, timeout = msg
+            now = time.monotonic()
+            dead = sum(1 for r, t in self.heartbeats.items()
+                       if now - t > timeout)
+            return ("n", dead)
+        if op == "stop":
+            return ("ok",)
+        raise ValueError("unknown op %r" % (op,))
+
+    def _maybe_wait_staleness(self, key, rank):
+        """SSP bound: block while this worker is > S pushes ahead of the
+        slowest worker that has ever pushed this key."""
+        if self.staleness is None:
+            return
+        with self._cv:
+            while True:
+                counts = self.push_counts.get(key) or {}
+                mine = counts.get(rank, 0) + 1  # counting THIS push
+                others = [c for r, c in counts.items() if r != rank]
+                if not others or mine - min(others) <= self.staleness:
+                    return
+                self._cv.wait(timeout=30.0)
+
+    def _apply(self, key, grad):
+        """Update-on-receive (reference kvstore_dist_server.h:282-294).
+        With no optimizer set, pushes overwrite (assignment) like the
+        reference's default merge for a single worker."""
+        if self.updater is None:
+            self.store[key] = grad.astype(self.store[key].dtype)
+            return
+        from ..ndarray import array as nd_array
+        w = nd_array(self.store[key])
+        g = nd_array(grad)
+        self.updater(key, g, w)
+        self.store[key] = w.asnumpy()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                msg = _recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                reply = self.server.ps.handle(msg)
+            except Exception as e:  # surface server-side errors to worker
+                reply = ("err", repr(e))
+            _send_frame(self.request, reply)
+            if msg[0] == "stop":
+                self.server.shutdown()
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_forever(addr=("127.0.0.1", 0), staleness=None):
+    """Start the async PS; returns (server, (host, port)). Runs until a
+    ("stop",) frame arrives. The reference analog is
+    KVStoreDistServer::Run."""
+    srv = _TCPServer(addr, _Handler)
+    srv.ps = AsyncPSServer(staleness=staleness)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv._thread = t  # join()able by KVStoreServer.run
+    return srv, srv.server_address
+
+
+class AsyncPSClient:
+    """Worker-side connection (one socket; the GIL-free socket wait means
+    device work keeps overlapping)."""
+
+    def __init__(self, addr=None, rank=0):
+        if addr is None:
+            host = os.environ.get("MXNET_PS_HOST", "127.0.0.1")
+            port = int(os.environ.get("MXNET_PS_PORT", "9090"))
+            addr = (host, port)
+        self.rank = rank
+        self._sock = socket.create_connection(addr, timeout=120)
+        self._lock = threading.Lock()
+
+    def _rpc(self, *msg):
+        with self._lock:
+            _send_frame(self._sock, msg)
+            reply = _recv_frame(self._sock)
+        if reply[0] == "err":
+            raise RuntimeError("async PS server error: %s" % reply[1])
+        return reply
+
+    def init(self, key, value):
+        self._rpc("init", key, np.asarray(value))
+
+    def push(self, key, grad):
+        self._rpc("push", key, np.asarray(grad), self.rank)
+
+    def pull(self, key):
+        return self._rpc("pull", key)[1]
+
+    def set_optimizer(self, optimizer):
+        self._rpc("set_optimizer", pickle.dumps(optimizer))
+
+    def heartbeat(self):
+        self._rpc("heartbeat", self.rank)
+
+    def num_dead_node(self, node_id=0, timeout=60):
+        return self._rpc("num_dead", node_id, timeout)[1]
+
+    def stop_server(self):
+        try:
+            self._rpc("stop")
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,148 @@
+"""True dist_async (reference src/kvstore/kvstore_dist_server.h:282-294):
+update-on-push with no global barrier — a slow worker must not block fast
+ones — plus heartbeat-based failure detection and SSP staleness bounds.
+
+Launched test: worker subprocesses connect to an in-test async PS over TCP
+(`parallel/ps_async`), the ps-lite analog."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel import ps_async
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+
+rank = int(sys.argv[1])
+n_push = int(sys.argv[2])
+sleep_s = float(sys.argv[3])
+
+kv = mx.kv.create("dist_async")
+w = mx.nd.ones((4,))
+kv.init("w", w)
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+t0 = time.time()
+for i in range(n_push):
+    g = mx.nd.ones((4,))
+    kv.push("w", g)
+    kv.pull("w", out=w)
+    if sleep_s:
+        time.sleep(sleep_s)
+print("WORKER %d DONE %.3f" % (rank, time.time() - t0), flush=True)
+"""
+
+
+def _spawn_worker(tmp_path, rank, n_push, sleep_s, port, extra_env=None):
+    script = tmp_path / ("worker%d.py" % rank)
+    script.write_text(WORKER)
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=REPO,
+               MXNET_PS_HOST="127.0.0.1", MXNET_PS_PORT=str(port),
+               MXNET_PS_RANK=str(rank), MXNET_PS_NUM_WORKERS="2")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, str(script), str(rank), str(n_push), str(sleep_s)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def test_async_server_updates_on_push():
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        c = ps_async.AsyncPSClient((host, port), rank=0)
+        c.init("w", np.ones(3, np.float32))
+        # no optimizer: pushes assign
+        c.push("w", np.full(3, 7.0, np.float32))
+        np.testing.assert_allclose(c.pull("w"), 7.0)
+        # with optimizer: update-on-receive
+        from mxnet_tpu.optimizer import SGD
+        c.set_optimizer(SGD(learning_rate=0.5, rescale_grad=1.0))
+        c.push("w", np.ones(3, np.float32))
+        np.testing.assert_allclose(c.pull("w"), 6.5)
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_async_slow_worker_does_not_block_fast(tmp_path):
+    """Fast worker completes its pushes while the slow one is still
+    sleeping — impossible under BSP where every push barriers."""
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        fast = _spawn_worker(tmp_path, 0, 20, 0.0, port)
+        slow = _spawn_worker(tmp_path, 1, 3, 1.5, port)
+        out_fast, _ = fast.communicate(timeout=120)
+        assert fast.returncode == 0, out_fast
+        assert "DONE" in out_fast
+        # the worker-reported push-loop time excludes the ~15s process
+        # startup: 20 pushes must finish well under the slow worker's
+        # >=4.5s of sleep — impossible if pushes barriered across workers
+        fast_loop = float(out_fast.split("DONE")[1].split()[0])
+        assert fast_loop < 4.0, (fast_loop, out_fast)
+        out_slow, _ = slow.communicate(timeout=120)
+        assert slow.returncode == 0, out_slow
+        slow_loop = float(out_slow.split("DONE")[1].split()[0])
+        assert slow_loop >= 4.5  # it really was sleeping through its loop
+        # both workers' updates landed on the same key
+        c = ps_async.AsyncPSClient((host, port), rank=9)
+        val = c.pull("w")
+        assert np.isfinite(val).all()
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_async_heartbeat_failure_detection():
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        a = ps_async.AsyncPSClient((host, port), rank=0)
+        b = ps_async.AsyncPSClient((host, port), rank=1)
+        a.heartbeat()
+        b.heartbeat()
+        assert a.num_dead_node(timeout=60) == 0
+        time.sleep(0.3)
+        a.heartbeat()  # b goes silent
+        assert a.num_dead_node(timeout=0.2) == 1  # b exceeded the timeout
+        assert a.num_dead_node(timeout=60) == 0
+    finally:
+        srv.shutdown()
+
+
+def test_async_staleness_bound_blocks_runaway_worker():
+    """SSP: with staleness S=2, a worker 3 pushes ahead blocks until the
+    laggard catches up."""
+    srv, (host, port) = ps_async.serve_forever(staleness=2)
+    try:
+        a = ps_async.AsyncPSClient((host, port), rank=0)
+        b = ps_async.AsyncPSClient((host, port), rank=1)
+        a.init("w", np.zeros(2, np.float32))
+        b_pushed = []
+
+        a.push("w", np.ones(2, np.float32))  # both have pushed once; a=1
+        b.push("w", np.ones(2, np.float32))  # b=1
+        a.push("w", np.ones(2, np.float32))  # a=2
+        a.push("w", np.ones(2, np.float32))  # a=3, b=1: a is 2 ahead (=S ok)
+
+        import threading
+        done = threading.Event()
+
+        def runaway():
+            a.push("w", np.ones(2, np.float32))  # would be 3 ahead: blocks
+            done.set()
+
+        t = threading.Thread(target=runaway, daemon=True)
+        t.start()
+        assert not done.wait(timeout=0.8)  # blocked by the SSP bound
+        b.push("w", np.ones(2, np.float32))  # laggard catches up (b=2)
+        assert done.wait(timeout=10)  # unblocked
+    finally:
+        srv.shutdown()
