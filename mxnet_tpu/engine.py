@@ -96,17 +96,30 @@ def fence(arrs):
         except Exception:
             continue  # deleted buffers between listing and wait are fine
         if _needs_readback(a):
-            dev = next(iter(a.devices()))
-            by_dev.setdefault(dev, []).append(a)
+            devs = a.devices()
+            # group by PLACEMENT: a mesh-sharded array (SPMD module) cannot
+            # share a probe program with single-device buffers
+            place = a.sharding if len(devs) > 1 else next(iter(devs))
+            by_dev.setdefault(place, []).append(a)
     for dev, group in by_dev.items():
         by_sig = {}
         for a in group:
             by_sig.setdefault((tuple(a.shape), str(a.dtype)), []).append(a)
         acc = _FENCE_ZERO.get(dev)
         if acc is None:
-            # cached per-device zero: seeding the chain must not pay a
+            # cached per-placement zero: seeding the chain must not pay a
             # host->device transfer per fence on the ~40ms tunnel
-            acc = _FENCE_ZERO[dev] = jax.device_put(np.float32(0), dev)
+            seed_place = dev
+            if hasattr(dev, "mesh"):  # NamedSharding -> replicated seed
+                from jax.sharding import NamedSharding, PartitionSpec
+                seed_place = NamedSharding(dev.mesh, PartitionSpec())
+            try:
+                acc = jax.device_put(np.float32(0), seed_place)
+            except Exception:  # exotic sharding: weak scalar, jit commits it
+                acc = np.float32(0)
+            _FENCE_ZERO[dev] = acc
+        platform = dev.platform if hasattr(dev, "platform") \
+            else next(iter(dev.device_set)).platform
         for (shape, dtype), xs in by_sig.items():
             i = 0
             while i < len(xs):
@@ -118,7 +131,7 @@ def fence(arrs):
                     bucket *= 2
                 chunk = xs[i:i + bucket]
                 i += bucket
-                fn = _probe_fn((dev.platform, shape, dtype, bucket))
+                fn = _probe_fn((platform, shape, dtype, bucket))
                 acc = fn(acc, *chunk)
         # device errors surface at this read — the reference rethrows async
         # exceptions at WaitForVar/WaitForAll the same way
