@@ -52,7 +52,7 @@ def main():
         # network out of the loop and we'd time ONE forward, not `iters`
         def body(i, acc):
             xi = jnp.roll(xv, i, axis=0)
-            return acc + cached(pv, key, False, xi)[0].sum()
+            return acc + cached(pv, key, False, xi)[0][0].sum()
         return lax.fori_loop(0, iters, body, acc0)
 
     xv = x._data

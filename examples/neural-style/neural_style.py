@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Neural style transfer (reference example/neural-style: Gatys et al. —
+optimize the INPUT IMAGE so its conv features match a content image and
+its feature Gram matrices match a style image).
+
+TPU-native formulation: the optimized variable is the image itself; the
+whole step (feature extraction through a conv tower + content/style losses
++ Adam on pixels) is the framework's autograd over registered ops, so each
+iteration is a handful of fused XLA dispatches. The reference downloads
+VGG-19 weights; here the feature tower is a fixed randomly-initialized
+conv net (random-feature style transfer is a known-good approximation and
+keeps the example self-contained — swap in model-zoo VGG weights for the
+full effect).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn
+
+
+def build_feature_net(channels=(16, 32, 64)):
+    """Fixed conv tower; returns activations at every scale."""
+    net = nn.HybridSequential()
+    for c in channels:
+        net.add(nn.Conv2D(c, 3, padding=1), nn.Activation("relu"),
+                nn.MaxPool2D(2))
+    net.initialize(mx.init.Xavier(magnitude=2))
+    return net
+
+
+def features(net, x):
+    acts = []
+    for layer in net._children.values():
+        x = layer(x)
+        if isinstance(layer, nn.Activation):
+            acts.append(x)
+    return acts
+
+
+def gram(feat):
+    b, c, h, w = feat.shape
+    f = feat.reshape((c, h * w))
+    return mx.nd.dot(f, f.T) / (c * h * w)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=64)
+    p.add_argument("--iters", type=int, default=60)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--style-weight", type=float, default=100.0)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    size = args.size
+    # synthetic content (smooth blob) and style (high-frequency stripes)
+    yy, xx = np.mgrid[0:size, 0:size] / size
+    content_np = np.stack([np.exp(-((xx - .5) ** 2 + (yy - .5) ** 2) * 8)]
+                          * 3)[None].astype(np.float32)
+    style_np = np.stack([np.sin(xx * 25 + i) for i in range(3)])[None] \
+        .astype(np.float32)
+
+    net = build_feature_net()
+    content = mx.nd.array(content_np)
+    style = mx.nd.array(style_np)
+    with autograd.pause():
+        content_feats = [f.detach() for f in features(net, content)]
+        style_grams = [gram(f).detach() for f in features(net, style)]
+
+    img = mx.nd.array(content_np + 0.1 * rng.randn(*content_np.shape)
+                      .astype(np.float32))
+    img.attach_grad()
+    opt = mx.optimizer.Adam(learning_rate=args.lr, rescale_grad=1.0)
+    state = opt.create_state(0, img)
+
+    first = last = None
+    for it in range(args.iters):
+        with autograd.record():
+            feats = features(net, img)
+            loss = 0
+            for f, cf in zip(feats, content_feats):
+                loss = loss + ((f - cf) ** 2).mean()
+            for f, sg in zip(feats, style_grams):
+                loss = loss + args.style_weight * ((gram(f) - sg) ** 2).mean()
+        loss.backward()
+        opt.update(0, img, img.grad, state)
+        lv = float(loss.asnumpy())
+        first = lv if first is None else first
+        last = lv
+        if it % 10 == 0:
+            print("iter %d loss %.4f" % (it, lv), flush=True)
+
+    print("style transfer loss %.4f -> %.4f" % (first, last))
+    assert last < first * 0.5, "optimization failed to reduce the loss"
+    print("NEURAL STYLE OK")
+
+
+if __name__ == "__main__":
+    main()

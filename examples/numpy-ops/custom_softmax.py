@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Custom numpy operator (reference example/numpy-ops/custom_softmax.py:
+implement softmax + its gradient as a user-defined CustomOp and train an
+MLP with it through Module).
+
+Demonstrates `mx.operator.CustomOp`/`CustomOpProp` — user compute runs as
+host callbacks exactly like the reference's numpy path (and therefore
+outside XLA fusion; use registered ops for production kernels)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y / l.shape[0]))
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-epochs", type=int, default=10)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 16).astype(np.float32)
+    W = rng.randn(16, 3).astype(np.float32)
+    y = X.dot(W).argmax(1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.Custom(fc, label, op_type="numpy_softmax", name="softmax")
+
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "rescale_grad": 1.0})
+    m = mx.metric.Accuracy()
+    for epoch in range(args.num_epochs):
+        it.reset()
+        m.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(m, batch.label)
+        print("epoch %d acc %.3f" % (epoch, m.get()[1]), flush=True)
+    assert m.get()[1] > 0.9, m.get()
+    print("CUSTOM NUMPY OP OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Profiler walkthrough (reference example/profiler/profiler_ndarray.py +
+profiler_matmul.py): trace a training loop, and print the aggregate
+per-op statistics table (`set_config(aggregate_stats=True,
+profile_memory=True)` + `dumps()`)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--trace", action="store_true",
+                   help="also write an XPlane/perfetto trace")
+    args = p.parse_args()
+
+    profiler.set_config(filename="profile_example.json",
+                        aggregate_stats=True, profile_memory=True)
+    if args.trace:
+        profiler.set_state("run")
+
+    a = mx.nd.array(np.random.rand(256, 256).astype(np.float32))
+    b = mx.nd.array(np.random.rand(256, 256).astype(np.float32))
+    for _ in range(args.iters):
+        c = mx.nd.dot(a, b)
+        d = mx.nd.relu(c) + a
+    d.asnumpy()
+
+    # a compiled executor shows up as one aggregated entry
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc")
+    exe = net.simple_bind(mx.cpu() if not mx.context.num_tpus()
+                          else mx.tpu(), data=(32, 128))
+    for _ in range(5):
+        exe.forward(is_train=False)
+
+    if args.trace:
+        profiler.set_state("stop")
+
+    table = profiler.dumps(reset=True)
+    print(table)
+    assert "dot" in table and "_executor_forward" in table
+    assert "Memory allocations" in table
+    print("PROFILER EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -93,7 +93,7 @@ def main():
     yb = jax.device_put(jnp.asarray(y_np), dev)
 
     def loss_fn(pv, xv, yv):
-        logits = cached(pv, key, True, xv)[0].astype(jnp.float32)
+        logits = cached(pv, key, True, xv)[0][0].astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, -1)
         return -jnp.mean(jnp.take_along_axis(
             logp.reshape(-1, V), yv.reshape(-1)[:, None], 1))

@@ -24,6 +24,7 @@ device dispatch, matching (and beating) the reference's bulked engine model.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import numpy as np
@@ -211,8 +212,12 @@ class Executor:
             outs, aux_up = self._monitored_eval(arg_vals, aux_vals, is_train,
                                                 key)
         else:
+            from . import profiler
+            t0 = time.perf_counter()
             outs, aux_up = self._jit_fwd(arg_vals, aux_vals, key,
                                          bool(is_train))
+            if profiler.aggregate_enabled():
+                profiler.finish_timed("_executor_forward", t0, outs)
         if is_train:
             # snapshot of pre-update inputs + key so a following backward()
             # recomputes the IDENTICAL forward (same dropout mask, idempotent
@@ -260,8 +265,12 @@ class Executor:
         other_args = {n: v for n, v in arg_vals.items()
                       if n not in self._grad_names}
         heads = _norm_head_grads(out_grads, len(self._output_names))
+        from . import profiler
+        t0 = time.perf_counter()
         outs, aux_up, grads = self._jit_fwd_bwd(
             grad_args, other_args, aux_vals, key, heads)
+        if profiler.aggregate_enabled():
+            profiler.finish_timed("_executor_forward_backward", t0, outs)
         for name, val in aux_up.items():
             self.aux_dict[name]._data = val
         for name, g in grads.items():

@@ -400,6 +400,7 @@ class HybridBlock(Block):
 
         def traced(param_vals, key, is_train, *input_vals):
             from .. import autograd, random as _random
+            from ..ops.invoke import _TLS as _invoke_tls
             param_nds = {n: _from_data(v) for n, v in zip(names, param_vals)}
             input_nds = [_from_data(v) if v is not None else None
                          for v in input_vals]
@@ -407,14 +408,29 @@ class HybridBlock(Block):
                 with _random.key_scope(key):
                     saved_rec = autograd.set_recording(False)
                     saved_train = autograd.set_training(is_train)
+                    # a parent's suppress_aux_writeback() warmup must not
+                    # leak into THIS trace: the aux skip would be baked
+                    # into the cached program forever (child BN stats
+                    # would never update)
+                    saved_aux = getattr(_invoke_tls, "no_aux", False)
+                    _invoke_tls.no_aux = False
                     try:
                         out = block._forward_impl(*input_nds)
                     finally:
                         autograd.set_recording(saved_rec)
                         autograd.set_training(saved_train)
+                        _invoke_tls.no_aux = saved_aux
+            # mutate-aux writebacks (BatchNorm moving stats) rebound the
+            # tracer NDArrays' ._data inside the trace; surface them as
+            # outputs or the updates are silently DISCARDED when
+            # _ParamOverride restores the real buffers (hybridized training
+            # would freeze BN statistics)
+            aux_up = {n: param_nds[n]._data
+                      for n, v in zip(names, param_vals)
+                      if param_nds[n]._data is not v}
             if isinstance(out, (list, tuple)):
-                return tuple(o._data for o in out)
-            return (out._data,)
+                return tuple(o._data for o in out), aux_up
+            return (out._data,), aux_up
 
         if self._flags.get("remat") or self._flags.get("static_alloc") == "remat":
             # rematerialize activations in backward instead of storing
@@ -445,10 +461,12 @@ class HybridBlock(Block):
         is_train = autograd.is_training()
 
         if autograd.is_recording():
-            # differentiable path: vjp through the jitted program
+            # differentiable path: vjp through the jitted program; aux
+            # (BN moving stats) rides along undifferentiated
             def f(pvals, ivals):
                 return self._cached_jit(pvals, key, is_train, *ivals)
-            outs, vjp_fn = jax.vjp(f, param_vals, input_vals)
+            outs, vjp_fn, aux_up = jax.vjp(f, param_vals, input_vals,
+                                           has_aux=True)
             tape_inputs = param_nds + [a for a in args if isinstance(a, NDArray)]
 
             def node_vjp(cots):
@@ -464,9 +482,13 @@ class HybridBlock(Block):
             for i, o in enumerate(out_nds):
                 o._autograd_node = (node, i)
         else:
-            outs = self._cached_jit(param_vals, key, is_train, *input_vals)
+            outs, aux_up = self._cached_jit(param_vals, key, is_train,
+                                            *input_vals)
             ctx = args[0].ctx if args and isinstance(args[0], NDArray) else None
             out_nds = [_from_data(o, ctx) for o in outs]
+        # commit mutated aux states (BN moving stats) back to the params
+        for n, v in aux_up.items():
+            params[n].data()._data = v
         return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
 
     def _forward_impl(self, *args):
@@ -502,9 +524,15 @@ class HybridBlock(Block):
             try:
                 return self._call_cached(x, *args)
             except DeferredInitializationError:
-                # one eager pass materialises deferred params, then compile
+                # one eager pass materialises deferred params, then compile.
+                # Its aux side effects (BN moving-stat updates) are rolled
+                # back: the compiled call that follows performs the SAME
+                # update (aux rides out of the cached program), and a
+                # double step would diverge from the eager trajectory.
                 self._clear_cached_op()
-                self._forward_impl(x, *args)
+                from ..ops.invoke import suppress_aux_writeback
+                with suppress_aux_writeback():
+                    self._forward_impl(x, *args)
                 return self._call_cached(x, *args)
         return self._forward_impl(x, *args)
 

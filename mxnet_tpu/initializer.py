@@ -81,6 +81,18 @@ class Initializer:
             self._init_zero(desc, arr)
         elif name.endswith("moving_avg"):
             self._init_zero(desc, arr)
+        elif name.endswith("parameters"):
+            # fused RNN parameter blob (RNN op's packed weights+biases,
+            # e.g. 'lstm_parameters'): the pack is 1-D, so shape-aware
+            # initializers (Xavier) can't apply — use a small uniform
+            # (the reference's classic 0.07 RNN default)
+            if getattr(arr, "ndim", 1) >= 2:
+                self._init_weight(desc, arr)
+            else:
+                self._set(arr, np.random.uniform(-0.07, 0.07, arr.shape))
+        elif name.endswith("state") or name.endswith("state_cell"):
+            # RNN initial hidden/cell state buffers default to zeros
+            self._init_zero(desc, arr)
         else:
             self._init_default(desc, arr)
 

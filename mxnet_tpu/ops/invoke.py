@@ -16,7 +16,26 @@ import jax
 from .. import autograd, engine
 from .registry import get_op
 
-__all__ = ["invoke"]
+__all__ = ["invoke", "suppress_aux_writeback"]
+
+import contextlib
+import threading
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_aux_writeback():
+    """Run ops WITHOUT committing mutate-aux updates (BN moving stats).
+    Used by HybridBlock's warmup forward: the compiled call that follows
+    performs the same update, and a double step would diverge from the
+    eager trajectory."""
+    prev = getattr(_TLS, "no_aux", False)
+    _TLS.no_aux = True
+    try:
+        yield
+    finally:
+        _TLS.no_aux = prev
 
 
 def _n_outputs(op, params):
@@ -50,19 +69,34 @@ def invoke(op_name, inputs, params=None, out=None, name=None, ctx=None):
     recording = autograd.is_recording() and any(
         x._autograd_node is not None or x._requires_grad for x in in_arrs)
 
+    from .. import profiler
+    _prof_t0 = None
+    if profiler.aggregate_enabled():
+        import time as _time
+        _prof_t0 = _time.perf_counter()
     if recording:
         fn = partial(_apply, op, params)
         raw_outs, vjp_fn = jax.vjp(fn, *vals)
     else:
         raw_outs = _apply(op, params, *vals)
         vjp_fn = None
+    if _prof_t0 is not None:
+        # aggregate-stats mode (reference aggregate_stats.cc): per-op
+        # wall time + output bytes; synchronizes the dispatch. Tracer
+        # outputs mean we're inside a jit trace — that wall time is
+        # compile work, not a dispatch; don't pollute the table with it.
+        leaves = raw_outs if isinstance(raw_outs, (tuple, list)) \
+            else (raw_outs,)
+        if not any(isinstance(v, jax.core.Tracer) for v in leaves):
+            profiler.finish_timed(op_name, _prof_t0, raw_outs)
     if not isinstance(raw_outs, (tuple, list)):
         raw_outs = (raw_outs,)
 
     # write back mutated aux inputs (reference mutable aux states)
     if n_aux:
-        for aux_idx, new_val in zip(op.mutate_aux, raw_outs[n_out:]):
-            in_arrs[aux_idx]._data = new_val
+        if not getattr(_TLS, "no_aux", False):
+            for aux_idx, new_val in zip(op.mutate_aux, raw_outs[n_out:]):
+                in_arrs[aux_idx]._data = new_val
         raw_outs = raw_outs[:n_out]
 
     out_arrs = [_from_data(v, ctx) for v in raw_outs]

@@ -1,11 +1,24 @@
 """Profiler.
 
 Parity with reference `python/mxnet/profiler.py` (set_config/set_state/
-dump/pause/resume) and `src/profiler/` (chrome://tracing output). TPU-native:
-delegates to `jax.profiler` — traces are XPlane/perfetto, viewable in
-TensorBoard or perfetto.dev (superset of the reference's chrome-trace).
-`MXNET_PROFILER_AUTOSTART=1` is honored like the reference
-(docs/faq/env_var.md:105).
+dump/dumps/pause/resume) and `src/profiler/`:
+
+- Tracing delegates to `jax.profiler` — traces are XPlane/perfetto,
+  viewable in TensorBoard or perfetto.dev (superset of the reference's
+  chrome://tracing output). `MXNET_PROFILER_AUTOSTART=1` honored
+  (reference docs/faq/env_var.md:105).
+- ``set_config(aggregate_stats=True)`` enables the in-process aggregate
+  table (reference `src/profiler/aggregate_stats.cc`): every eager op
+  dispatch and every compiled executor call is timed and folded into a
+  per-name count/total/min/max/avg table; ``dumps()`` returns it.
+- ``profile_memory=True`` additionally tracks bytes allocated per op
+  (output buffers) and samples the backend allocator's
+  ``bytes_in_use``/``peak_bytes_in_use`` (reference
+  `src/profiler/storage_profiler.h` GpuDeviceStorageProfiler).
+
+Timing caveat: aggregate mode synchronizes after each measured call so the
+numbers are wall-clock per dispatch; on relayed-PJRT backends that adds
+tunnel latency per op — profile on-device loops with the tracer instead.
 """
 from __future__ import annotations
 
@@ -14,9 +27,16 @@ import time
 
 import jax
 
-__all__ = ["set_config", "set_state", "dump", "pause", "resume"]
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
+           "reset_stats"]
 
 _state = {"running": False, "dir": "profile_output", "configured": False}
+_agg = {
+    "enabled": False,
+    "memory": False,
+    "ops": {},          # name -> [count, total_us, min_us, max_us]
+    "alloc": {},        # name -> [count, total_bytes, min_bytes, max_bytes]
+}
 
 
 def set_config(filename="profile.json", profile_all=False, profile_symbolic=True,
@@ -24,6 +44,11 @@ def set_config(filename="profile.json", profile_all=False, profile_symbolic=True
                aggregate_stats=False, **kwargs):
     _state["dir"] = os.path.splitext(filename)[0] + "_trace"
     _state["configured"] = True
+    # aggregate mode is a separate opt-in (like the reference): it
+    # synchronizes every dispatch, which profile_all users capturing a
+    # trace must not silently pay
+    _agg["enabled"] = bool(aggregate_stats)
+    _agg["memory"] = bool(profile_memory and aggregate_stats)
 
 
 def set_state(state="stop", profile_process="worker"):
@@ -56,8 +81,98 @@ def resume(profile_process="worker"):
         _state["running"] = True
 
 
-def dumps(reset=False):
-    return ""
+# ---------------------------------------------------------------------------
+# Aggregate statistics (reference src/profiler/aggregate_stats.cc)
+# ---------------------------------------------------------------------------
+
+def aggregate_enabled():
+    return _agg["enabled"]
+
+
+def memory_enabled():
+    return _agg["memory"]
+
+
+def record_op(name, dur_s, out_bytes=0):
+    """Fold one timed dispatch into the aggregate table. Called by the
+    eager dispatcher (`ops/invoke.py`) and the executor's compiled calls."""
+    us = dur_s * 1e6
+    rec = _agg["ops"].get(name)
+    if rec is None:
+        _agg["ops"][name] = [1, us, us, us]
+    else:
+        rec[0] += 1
+        rec[1] += us
+        rec[2] = min(rec[2], us)
+        rec[3] = max(rec[3], us)
+    if _agg["memory"] and out_bytes:
+        mrec = _agg["alloc"].get(name)
+        if mrec is None:
+            _agg["alloc"][name] = [1, out_bytes, out_bytes, out_bytes]
+        else:
+            mrec[0] += 1
+            mrec[1] += out_bytes
+            mrec[2] = min(mrec[2], out_bytes)
+            mrec[3] = max(mrec[3], out_bytes)
+
+
+def reset_stats():
+    _agg["ops"].clear()
+    _agg["alloc"].clear()
+
+
+def _device_memory_lines():
+    lines = []
+    try:
+        devs = jax.devices()
+    except Exception:  # pragma: no cover
+        return lines
+    for d in devs[:8]:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if not st:
+            continue
+        lines.append("Device %s: bytes_in_use=%d peak_bytes_in_use=%d"
+                     % (d, st.get("bytes_in_use", 0),
+                        st.get("peak_bytes_in_use", 0)))
+    return lines
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate-stats table (reference profiler.dumps ->
+    AggregateStats::DumpTable). Empty string when aggregate mode is off —
+    matching the reference when no stats were collected."""
+    if not _agg["ops"] and not _agg["alloc"]:
+        return ""
+    out = ["Profile Statistics.", "\tNote: aggregate statistics over all "
+           "timed dispatches since the last reset."]
+    hdr = ("%-32s %12s %14s %14s %14s %14s"
+           % ("Name", "Total Count", "Time (ms)", "Min Time (ms)",
+              "Max Time (ms)", "Avg Time (ms)"))
+    out += ["", hdr, "-" * len(hdr)]
+    for name in sorted(_agg["ops"], key=lambda n: -_agg["ops"][n][1]):
+        cnt, tot, mn, mx = _agg["ops"][name]
+        out.append("%-32s %12d %14.4f %14.4f %14.4f %14.4f"
+                   % (name[:32], cnt, tot / 1e3, mn / 1e3, mx / 1e3,
+                      tot / cnt / 1e3))
+    if _agg["memory"]:
+        out += ["", "Memory allocations (op output buffers)."]
+        hdr = ("%-32s %12s %14s %14s %14s"
+               % ("Name", "Total Count", "Total Bytes", "Min Bytes",
+                  "Max Bytes"))
+        out += [hdr, "-" * len(hdr)]
+        for name in sorted(_agg["alloc"], key=lambda n: -_agg["alloc"][n][1]):
+            cnt, tot, mn, mx = _agg["alloc"][name]
+            out.append("%-32s %12d %14d %14d %14d"
+                       % (name[:32], cnt, tot, mn, mx))
+        mem_lines = _device_memory_lines()
+        if mem_lines:
+            out += ["", "Backend allocator (PJRT memory_stats)."] + mem_lines
+    if reset:
+        reset_stats()
+    return "\n".join(out) + "\n"
 
 
 class Scope:
@@ -72,6 +187,18 @@ class Scope:
 
     def __exit__(self, *a):
         return self._ctx.__exit__(*a)
+
+
+def finish_timed(name, t0, outs):
+    """Synchronize ``outs``, then fold (name, elapsed, output bytes) into
+    the aggregate table. Dispatch sites call this only when
+    ``aggregate_enabled()``."""
+    jax.block_until_ready(outs)
+    nbytes = 0
+    if _agg["memory"]:
+        for leaf in jax.tree.leaves(outs):
+            nbytes += getattr(leaf, "nbytes", 0)
+    record_op(name, time.perf_counter() - t0, nbytes)
 
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":

@@ -184,3 +184,48 @@ def test_nce_loss():
     r = _run("nce-loss/train_nce.py", timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "rank-1 accuracy" in r.stdout
+
+
+def test_neural_style():
+    r = _run("neural-style/neural_style.py", "--size", "32", "--iters", "40")
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "NEURAL STYLE OK" in r.stdout
+
+
+def test_fcn_segmentation():
+    r = _run("fcn-xs/train_fcn.py", "--num-examples", "32",
+             "--num-epochs", "10", timeout=600)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "FCN SEGMENTATION OK" in r.stdout
+
+
+def test_speech_recognition_ctc():
+    r = _run("speech_recognition/train_am.py", timeout=1500)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "SPEECH AM OK" in r.stdout
+
+
+def test_parallel_actor_critic():
+    r = _run("reinforcement-learning/parallel_actor_critic.py",
+             "--updates", "400", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "PARALLEL ACTOR-CRITIC OK" in r.stdout
+
+
+def test_stochastic_depth():
+    r = _run("stochastic-depth/train_sd.py", "--num-epochs", "8",
+             timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "STOCHASTIC DEPTH OK" in r.stdout
+
+
+def test_numpy_ops_custom_softmax():
+    r = _run("numpy-ops/custom_softmax.py", "--num-epochs", "8")
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "CUSTOM NUMPY OP OK" in r.stdout
+
+
+def test_profiler_example():
+    r = _run("profiler/profiler_example.py")
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "PROFILER EXAMPLE OK" in r.stdout

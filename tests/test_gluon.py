@@ -183,3 +183,60 @@ def test_contrib_interval_sampler_and_wikitext(tmp_path):
     np.testing.assert_allclose(label.asnumpy()[:-1], data.asnumpy()[1:])
     with pytest.raises(IOError):
         gc.data.WikiText103(root=str(tmp_path / "nope"))
+
+
+def test_hybridized_batchnorm_updates_moving_stats():
+    """Round-3 fix: under hybridize() the BN moving-stats updates happen on
+    tracers; the cached program must surface them as aux outputs and commit
+    them back, or eval (global stats) silently uses the INITIAL stats."""
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.rand(16, 4).astype(np.float32) * 5 + 10)
+    with mx.autograd.record():
+        net(x)
+    bn = list(net._children.values())[0]
+    mean = bn.running_mean.data().asnumpy()
+    var = bn.running_var.data().asnumpy()
+    # one momentum-0.9 update from (0, 1) toward the batch stats
+    assert np.abs(mean).max() > 0.5, mean   # moved off the init value
+    assert np.abs(var - 1.0).max() > 0.1, var
+    # eager reference produces the same stats
+    net2 = nn.HybridSequential()
+    net2.add(nn.BatchNorm())
+    net2.initialize()
+    with mx.autograd.record():
+        net2(x)
+    bn2 = list(net2._children.values())[0]
+    np.testing.assert_allclose(mean, bn2.running_mean.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var, bn2.running_var.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hybridized_nested_deferred_bn_updates_stats():
+    """Review r3: a deferred-init BN CHILD called via __call__ inside a
+    parent's hybrid_forward must still commit moving stats — the parent's
+    warmup aux-suppression must not leak into the child's jit trace."""
+
+    class Wrapper(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.bn = nn.BatchNorm()  # in_channels deferred
+
+        def hybrid_forward(self, F, x):
+            return self.bn(x)
+
+    np.random.seed(1)
+    net = Wrapper()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.rand(16, 4).astype(np.float32) * 5 + 10)
+    with mx.autograd.record():
+        net(x)
+        net(x)
+    mean = net.bn.running_mean.data().asnumpy()
+    assert np.abs(mean).max() > 0.5, mean  # stats moved off init
