@@ -32,39 +32,71 @@ def import_model(model_file):
 
 # -- attribute/op translations ----------------------------------------------
 
-def _pad2d(pads):
-    # ONNX pads: [x1_begin, x2_begin, x1_end, x2_end]; conv/pool take one
-    # symmetric (ph, pw) — asymmetric padding must not be dropped silently
+def _maybe_pad(data, pads, n_spatial=2):
+    """ONNX pads [b0..bn, e0..en] -> (possibly pre-padded data, symmetric
+    pad tuple). Symmetric pads pass straight to conv/pool; asymmetric pads
+    insert an explicit zero Pad node (the reference importer refuses them;
+    here they lower to the same XLA pad the op would fuse anyway)."""
     if pads is None:
-        return (0, 0)
+        return data, (0,) * n_spatial
     n = len(pads) // 2
     begins, ends = tuple(pads[:n]), tuple(pads[n:])
-    if begins != ends:
-        raise NotImplementedError(
-            "asymmetric ONNX pads %s are not supported; insert an "
-            "explicit Pad node or re-export with symmetric padding"
-            % (pads,))
-    return begins
+    if begins == ends:
+        return data, begins
+    pad_width = (0, 0, 0, 0) + _onnx_pads_to_pad_width(pads)
+    data = sym.Pad(data, mode="constant", constant_value=0.0,
+                   pad_width=pad_width)
+    return data, (0,) * n
 
 
 def _conv(attrs, inputs, proto):
     kernel = tuple(attrs["kernel_shape"])
+    data, pad = _maybe_pad(inputs[0], attrs.get("pads"), len(kernel))
     return sym.Convolution(
-        *inputs, kernel=kernel,
+        data, *inputs[1:], kernel=kernel,
         stride=tuple(attrs.get("strides", (1,) * len(kernel))),
         dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
-        pad=_pad2d(attrs.get("pads")),
+        pad=pad,
         num_filter=proto._params[inputs[1].name].shape[0],
         num_group=attrs.get("group", 1),
         no_bias=(len(inputs) == 2))
 
 
+def _conv_transpose(attrs, inputs, proto):
+    """ONNX ConvTranspose pads CROP the output (opposite of Conv); the
+    symmetric case maps onto Deconvolution's crop-style pad, asymmetric
+    pads crop via slice_axis on the output."""
+    kernel = tuple(attrs["kernel_shape"])
+    pads = attrs.get("pads")
+    n = len(kernel)
+    begins = tuple(pads[:n]) if pads else (0,) * n
+    ends = tuple(pads[n:]) if pads else (0,) * n
+    symmetric = begins == ends
+    out = sym.Deconvolution(
+        *inputs, kernel=kernel,
+        stride=tuple(attrs.get("strides", (1,) * n)),
+        dilate=tuple(attrs.get("dilations", (1,) * n)),
+        adj=tuple(attrs.get("output_padding", (0,) * n)),
+        pad=begins if symmetric else (0,) * n,
+        num_filter=proto._params[inputs[1].name].shape[1],
+        num_group=attrs.get("group", 1),
+        no_bias=(len(inputs) == 2))
+    if not symmetric:
+        for ax, (b, e) in enumerate(zip(begins, ends)):
+            if b or e:
+                out = sym.slice_axis(out, axis=2 + ax, begin=int(b),
+                                     end=-int(e) if e else None)
+    return out
+
+
 def _pool(pool_type):
     def impl(attrs, inputs, proto):
+        kernel = tuple(attrs["kernel_shape"])
+        data, pad = _maybe_pad(inputs[0], attrs.get("pads"), len(kernel))
         return sym.Pooling(
-            inputs[0], kernel=tuple(attrs["kernel_shape"]),
+            data, kernel=kernel,
             stride=tuple(attrs.get("strides", (1, 1))),
-            pad=_pad2d(attrs.get("pads")), pool_type=pool_type)
+            pad=pad, pool_type=pool_type)
     return impl
 
 
@@ -184,6 +216,41 @@ def _reduce(op):
     return impl
 
 
+def _gather(attrs, inputs, proto):
+    # ONNX allows negative indices (wrap from the end); take's default
+    # clip mode would silently send them to index 0
+    return sym.take(inputs[0], inputs[1], axis=attrs.get("axis", 0),
+                    mode="wrap")
+
+
+def _slice(attrs, inputs, proto):
+    axes = attrs.get("axes")
+    starts = tuple(attrs["starts"])
+    ends = tuple(attrs["ends"])
+    out = inputs[0]
+    if axes is None:
+        axes = tuple(range(len(starts)))
+    for ax, b, e in zip(axes, starts, ends):
+        out = sym.slice_axis(out, axis=int(ax), begin=int(b),
+                             end=None if e >= 2 ** 31 - 1 else int(e))
+    return out
+
+
+def _split(attrs, inputs, proto):
+    axis = attrs.get("axis", 0)
+    if "split" in attrs:
+        sizes = tuple(attrs["split"])
+        outs, begin = [], 0
+        for sz in sizes:
+            outs.append(sym.slice_axis(inputs[0], axis=axis, begin=begin,
+                                       end=begin + sz))
+            begin += sz
+        return outs
+    return list(sym.SliceChannel(inputs[0], num_outputs=attrs["num_outputs"],
+                                 axis=axis))
+
+
+
 _CONVERT_MAP = {
     "Conv": _conv,
     "Gemm": _gemm,
@@ -220,6 +287,30 @@ _CONVERT_MAP = {
     "Squeeze": lambda a, i, p: sym.squeeze(
         i[0], axis=tuple(a.get("axes", ())) or None),
     "Unsqueeze": lambda a, i, p: _unsqueeze(a, i),
+    "Exp": lambda a, i, p: sym.exp(i[0]),
+    "Log": lambda a, i, p: sym.log(i[0]),
+    "Sqrt": lambda a, i, p: sym.sqrt(i[0]),
+    "Neg": lambda a, i, p: sym.negative(i[0]),
+    "Abs": lambda a, i, p: sym.abs(i[0]),
+    "Reciprocal": lambda a, i, p: sym.reciprocal(i[0]),
+    "Floor": lambda a, i, p: sym.floor(i[0]),
+    "Ceil": lambda a, i, p: sym.ceil(i[0]),
+    "Pow": lambda a, i, p: sym.broadcast_power(*i),
+    "Max": lambda a, i, p: sym.broadcast_maximum(*i),
+    "Min": lambda a, i, p: sym.broadcast_minimum(*i),
+    "Gather": _gather,
+    "Slice": _slice,
+    "Split": _split,
+    "ConvTranspose": _conv_transpose,
+    "LRN": lambda a, i, p: sym.LRN(
+        i[0], alpha=a.get("alpha", 1e-4), beta=a.get("beta", 0.75),
+        knorm=a.get("bias", 1.0), nsize=a["size"]),
+    "InstanceNormalization": lambda a, i, p: sym.InstanceNorm(
+        *i, eps=a.get("epsilon", 1e-5)),
+    "Softplus": lambda a, i, p: sym.Activation(i[0], act_type="softrelu"),
+    "HardSigmoid": lambda a, i, p: sym.clip(
+        i[0] * a.get("alpha", 0.2) + a.get("beta", 0.5), 0.0, 1.0),
+    "Constant": None,  # handled inline in from_onnx (tensor attribute)
     "Pad": lambda a, i, p: sym.Pad(
         i[0], mode=a.get("mode", "constant"),
         pad_width=_onnx_pads_to_pad_width(a.get("pads", ())),
@@ -266,12 +357,13 @@ class GraphProto(object):
             for f in ("floats", "ints", "strings"):
                 if list(getattr(a, f)):
                     attrs[a.name] = tuple(getattr(a, f))
-            for f in ("t", "g", "tensors", "graphs"):
-                if a.HasField(f) if f in ("t", "g") \
-                        else list(getattr(a, f)):
+            if a.HasField("t"):
+                attrs[a.name] = a.t  # raw TensorProto (Constant nodes)
+            for f in ("g", "tensors", "graphs"):
+                if a.HasField(f) if f == "g" else list(getattr(a, f)):
                     raise NotImplementedError(
-                        "attribute %s with field %s is not supported"
-                        % (a.name, f))
+                        "attribute %s with field %s (subgraph) is not "
+                        "supported" % (a.name, f))
         return attrs
 
     def from_onnx(self, graph):
@@ -292,10 +384,37 @@ class GraphProto(object):
         for node in graph.node:
             op = node.op_type
             attrs = self._parse_attr(node.attribute)
+            if op == "Constant":
+                name = node.output[0]
+                if "value" in attrs:
+                    from onnx import numpy_helper
+                    val = np.asarray(numpy_helper.to_array(attrs["value"]))
+                elif "value_float" in attrs:
+                    val = np.asarray(attrs["value_float"], np.float32)
+                elif "value_int" in attrs:
+                    val = np.asarray(attrs["value_int"], np.int64)
+                elif "value_floats" in attrs:
+                    val = np.asarray(attrs["value_floats"], np.float32)
+                elif "value_ints" in attrs:
+                    val = np.asarray(attrs["value_ints"], np.int64)
+                else:
+                    raise NotImplementedError(
+                        "Constant node with attributes %s is not supported"
+                        % sorted(attrs))
+                self._params[name] = nd.array(val)
+                self._nodes[name] = sym.Variable(
+                    name, shape=self._params[name].shape)
+                continue
+            if op == "Split":
+                # before opset 18 the output count is only on the node
+                attrs.setdefault("num_outputs", len(node.output))
             inputs = [self._nodes[i] for i in node.input]
-            if op not in _CONVERT_MAP:
+            if _CONVERT_MAP.get(op) is None:
                 raise NotImplementedError(
-                    "ONNX operator %s is not yet supported" % op)
+                    "ONNX operator %s is not yet supported (supported: "
+                    "%s)" % (op, ", ".join(sorted(
+                        k for k, v in _CONVERT_MAP.items()
+                        if v is not None))))
             out = _CONVERT_MAP[op](attrs, inputs, self)
             outputs = out if isinstance(out, (list, tuple)) else [out]
             for k, name in enumerate(node.output):

@@ -146,3 +146,89 @@ def test_dataloader_iter():
 def test_onnx_gated():
     with pytest.raises(ImportError, match="onnx"):
         mx.contrib.onnx.import_model("/tmp/nonexistent.onnx")
+
+
+def test_onnx_translations_no_onnx_needed():
+    """The ONNX node translators are pure Symbol builders — exercise them
+    directly (asymmetric pads now insert an explicit Pad node instead of
+    raising; reference importer refuses them)."""
+    import importlib
+    om = importlib.import_module("mxnet_tpu.contrib.onnx.import_model")
+    import numpy as np
+
+    class StubProto:
+        _params = {}
+
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    StubProto._params = {"w": mx.nd.ones((2, 3, 3, 3))}
+
+    # asymmetric pads: explicit Pad + zero conv padding
+    conv = om._CONVERT_MAP["Conv"](
+        {"kernel_shape": (3, 3), "pads": (1, 0, 0, 1)}, [x, w], StubProto)
+    out = conv.eval(x=mx.nd.ones((1, 3, 8, 8)),
+                    w=mx.nd.ones((2, 3, 3, 3)))[0]
+    assert out.shape == (1, 2, 7, 7)  # (8+1+0-3+1, 8+0+1-3+1)
+
+    # Gather / Slice / Split
+    g = om._CONVERT_MAP["Gather"]({"axis": 0}, [x, mx.sym.Variable("idx")],
+                                  StubProto)
+    got = g.eval(x=mx.nd.array(np.arange(12).reshape(4, 3)),
+                 idx=mx.nd.array([2.0, 0.0]))[0]
+    np.testing.assert_allclose(got.asnumpy()[0], [6, 7, 8])
+
+    s = om._CONVERT_MAP["Slice"]({"starts": (1,), "ends": (3,),
+                                  "axes": (0,)}, [x], StubProto)
+    got = s.eval(x=mx.nd.array(np.arange(5, dtype="f")))[0]
+    np.testing.assert_allclose(got.asnumpy(), [1, 2])
+
+    outs = om._CONVERT_MAP["Split"]({"axis": 1, "split": (2, 2)},
+                                    [x], StubProto)
+    assert len(outs) == 2
+    got = outs[1].eval(x=mx.nd.array(np.arange(8, dtype="f")
+                                     .reshape(2, 4)))[0]
+    np.testing.assert_allclose(got.asnumpy(), [[2, 3], [6, 7]])
+
+    # HardSigmoid / Softplus / elementwise unary
+    hs = om._CONVERT_MAP["HardSigmoid"]({}, [x], StubProto)
+    got = hs.eval(x=mx.nd.array([-10.0, 0.0, 10.0]))[0]
+    np.testing.assert_allclose(got.asnumpy(), [0.0, 0.5, 1.0])
+    for name, fn in [("Exp", np.exp), ("Sqrt", np.sqrt), ("Abs", np.abs)]:
+        sym_ = om._CONVERT_MAP[name]({}, [x], StubProto)
+        got = sym_.eval(x=mx.nd.array([1.0, 4.0]))[0]
+        np.testing.assert_allclose(got.asnumpy(), fn([1.0, 4.0]),
+                                   rtol=1e-6)
+
+
+def test_onnx_conv_transpose_and_gather_semantics():
+    """ConvTranspose pads CROP the output (not pad the input); Gather
+    wraps negative indices (review r3 findings)."""
+    import importlib
+    om = importlib.import_module("mxnet_tpu.contrib.onnx.import_model")
+    import numpy as np
+
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+
+    class P:
+        _params = {"w": mx.nd.ones((3, 2, 3, 3))}
+
+    # symmetric pads: out = stride*(in-1) + k - 2p = 2*3+3-2 = 7
+    ct = om._CONVERT_MAP["ConvTranspose"](
+        {"kernel_shape": (3, 3), "strides": (2, 2), "pads": (1, 1, 1, 1)},
+        [x, w], P)
+    out = ct.eval(x=mx.nd.ones((1, 3, 4, 4)), w=mx.nd.ones((3, 2, 3, 3)))[0]
+    assert out.shape == (1, 2, 7, 7), out.shape
+
+    # asymmetric pads crop per-edge: full out 9, crop (1,0),(0,1) -> 8x8
+    ct = om._CONVERT_MAP["ConvTranspose"](
+        {"kernel_shape": (3, 3), "strides": (2, 2), "pads": (1, 0, 0, 1)},
+        [x, w], P)
+    out = ct.eval(x=mx.nd.ones((1, 3, 4, 4)), w=mx.nd.ones((3, 2, 3, 3)))[0]
+    assert out.shape == (1, 2, 8, 8), out.shape
+
+    # Gather with negative index wraps to the end
+    g = om._CONVERT_MAP["Gather"]({"axis": 0}, [x, mx.sym.Variable("i")], P)
+    got = g.eval(x=mx.nd.array(np.arange(4, dtype="f")),
+                 i=mx.nd.array([-1.0]))[0]
+    np.testing.assert_allclose(got.asnumpy(), [3.0])
