@@ -29,12 +29,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 
-def build_module(model, batch, shape, num_classes, dtype, ctx, lr):
+def build_module(model, batch, shape, num_classes, dtype, ctx, lr,
+                 layout="NCHW"):
     """Gluon zoo net -> traced Symbol -> Module bound at `dtype`."""
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
 
-    net = vision.get_model(model, classes=num_classes)
+    if layout != "NCHW" and not model.startswith("resnet"):
+        raise SystemExit("--layout NHWC is implemented for the resnet "
+                         "family only (model %s is NCHW)" % model)
+    kwargs = {} if layout == "NCHW" else {"layout": layout}
+    net = vision.get_model(model, classes=num_classes, **kwargs)
     net.initialize(mx.init.Xavier(), ctx=ctx)
     net(mx.nd.zeros((batch,) + shape, ctx=ctx))  # materialize params
     sym = net._trace_symbol()
@@ -68,17 +73,21 @@ def main():
     p.add_argument("--batches-per-dispatch", type=int, default=10)
     p.add_argument("--num-calls", type=int, default=3)
     p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"],
+                   help="NHWC is the TPU-native conv layout")
     args = p.parse_args()
 
     import mxnet_tpu as mx
     from mxnet_tpu.io import DataBatch
 
     shape = tuple(int(s) for s in args.image_shape.split(","))
+    if args.layout == "NHWC":
+        shape = (shape[1], shape[2], shape[0])
     batch = args.batch_size
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
 
     mod = build_module(args.model, batch, shape, args.num_classes,
-                       args.dtype, ctx, args.lr)
+                       args.dtype, ctx, args.lr, layout=args.layout)
 
     rng = np.random.RandomState(0)
     K = args.batches_per_dispatch

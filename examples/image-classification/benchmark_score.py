@@ -32,6 +32,15 @@ NETS = {
 
 
 def score(network, batch_size, ctx, image=224, iters=20, dtype="float32"):
+    """Chained-dispatch measurement (bench.py discipline): the timed
+    iterations run inside ONE compiled loop over the hybridized forward,
+    chained across a few invocations by a data dependency, with a single
+    scalar read at the end — on a relayed PJRT backend per-call host
+    timing measures the ~40ms tunnel dispatch, not the chip."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
     net = NETS[network]()
     net.initialize(ctx=ctx)
     net.hybridize()
@@ -40,14 +49,30 @@ def score(network, batch_size, ctx, image=224, iters=20, dtype="float32"):
                              ctx=ctx).astype(dtype)
     if dtype != "float32":
         net.cast(dtype)
-    net(x).asnumpy()  # compile
+    net(x).asnumpy()  # build + warm the cached jit
+    cached = net._cached_jit
+    params = tuple(net.collect_params()[n].data()._data
+                   for n in net._param_order)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def loop(pv, xv, acc0):
+        def body(i, acc):
+            # roll so the forward depends on i (stops XLA hoisting it)
+            xi = jnp.roll(xv, i, axis=0)
+            return acc + cached(pv, key, False, xi)[0][0].sum() \
+                .astype(jnp.float32)
+        return lax.fori_loop(0, iters, body, acc0)
+
+    calls = 4
+    float(loop(params, x._data, jnp.float32(0)))  # compile
     t0 = time.time()
-    out = None
-    for _ in range(iters):
-        out = net(x)
-    out.asnumpy()
+    acc = jnp.float32(0)
+    for _ in range(calls):
+        acc = loop(params, x._data, acc)
+    float(acc)
     dt = time.time() - t0
-    return batch_size * iters / dt
+    return batch_size * iters * calls / dt
 
 
 def main():

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Multivariate time-series forecasting (reference
+example/multivariate_time_series: LSTNet — conv feature extraction over a
+window of multivariate history + recurrent layer + autoregressive highway,
+forecasting every series one step ahead).
+
+TPU-native compact LSTNet: Conv1D over the (window, series) panel, GRU on
+the conv features, dense forecast head, plus the AR highway. Trained with
+gluon Trainer; synthetic data = coupled noisy sinusoids (each series a
+phase-shifted mixture), so forecastability is real. Metric: relative RMSE
+beats the naive last-value predictor by a wide margin."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, rnn
+
+
+class LSTNet(gluon.HybridBlock):
+    def __init__(self, n_series, window, conv_ch=16, rnn_h=32, ar_window=4,
+                 **kw):
+        super().__init__(**kw)
+        self.ar_window = ar_window
+        with self.name_scope():
+            self.conv = nn.Conv1D(conv_ch, kernel_size=3,
+                                  in_channels=n_series)
+            self.gru = rnn.GRU(rnn_h, num_layers=1, layout="NTC",
+                               input_size=conv_ch)
+            self.head = nn.Dense(n_series, in_units=rnn_h)
+            self.ar = nn.Dense(1, in_units=ar_window, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        # x: (B, T, S)
+        c = self.conv(F.transpose(x, axes=(0, 2, 1)))   # (B, C, T')
+        c = F.Activation(c, act_type="relu")
+        h = self.gru(F.transpose(c, axes=(0, 2, 1)))    # (B, T', H)
+        h_last = F.slice_axis(h, axis=1, begin=-1, end=None)
+        out = self.head(F.Reshape(h_last, shape=(0, -1)))  # (B, S)
+        # autoregressive highway on the last ar_window steps per series
+        tail = F.slice_axis(x, axis=1, begin=-self.ar_window, end=None)
+        ar_in = F.transpose(tail, axes=(0, 2, 1))       # (B, S, ar)
+        ar_out = F.Reshape(self.ar(ar_in), shape=(0, -1))  # (B, S)
+        return out + ar_out
+
+
+def make_panel(n_series, length, rng):
+    t = np.arange(length)
+    base = np.stack([np.sin(2 * np.pi * t / (20 + 3 * s) + s)
+                     for s in range(n_series)], axis=1)
+    cross = 0.3 * np.roll(base, 1, axis=1)  # series couple to a neighbor
+    return (base + cross + 0.05 * rng.randn(length, n_series)) \
+        .astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-series", type=int, default=6)
+    p.add_argument("--window", type=int, default=24)
+    p.add_argument("--length", type=int, default=600)
+    p.add_argument("--num-epochs", type=int, default=15)
+    p.add_argument("--horizon", type=int, default=3,
+                   help="steps ahead to forecast (the reference LSTNet "
+                        "benchmarks horizons 3/6/12/24)")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.005)
+    args = p.parse_args()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    panel = make_panel(args.num_series, args.length, rng)
+    W = args.window
+    h = args.horizon
+    X = np.stack([panel[i:i + W] for i in range(len(panel) - W - h + 1)])
+    Y = np.stack([panel[i + W + h - 1]
+                  for i in range(len(panel) - W - h + 1)])
+
+    net = LSTNet(args.num_series, W)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    bs = args.batch_size
+    n_train = (len(X) * 4 // 5 // bs) * bs
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        for i in range(0, n_train, bs):
+            xb = mx.nd.array(X[i:i + bs])
+            yb = mx.nd.array(Y[i:i + bs])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(bs)
+            tot += float(loss.mean().asnumpy())
+        if epoch % 3 == 0:
+            print("epoch %d loss %.5f" % (epoch, tot / (n_train // bs)),
+                  flush=True)
+
+    # held-out forecast RMSE vs the naive last-value predictor
+    Xt, Yt = X[n_train:], Y[n_train:]
+    pred = net(mx.nd.array(Xt)).asnumpy()
+    rmse = np.sqrt(((pred - Yt) ** 2).mean())
+    naive = np.sqrt(((Xt[:, -1, :] - Yt) ** 2).mean())
+    print("forecast RMSE %.4f vs naive %.4f" % (rmse, naive))
+    assert rmse < naive * 0.6, (rmse, naive)
+    print("LSTNET FORECAST OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Time-major vs batch-major RNN layout (reference example/rnn-time-major:
+time-major buffers avoid a transpose per step and run measurably faster).
+
+TPU-native: the fused RNN op is natively TIME-major (T, B, C) — scan over
+the leading axis; a batch-major (B, T, C) model pays an explicit transpose
+at the graph edge. This script trains the same LM both ways, checks they
+agree, and prints the throughput of each layout."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def lm_symbol(time_major, T, V, E, H):
+    data = mx.sym.Variable("data")   # (T,B) or (B,T)
+    emb = mx.sym.Embedding(data, input_dim=V, output_dim=E, name="embed")
+    if not time_major:
+        emb = mx.sym.transpose(emb, axes=(1, 0, 2))  # -> (T, B, E)
+    rnn = mx.sym.RNN(emb, state_size=H, num_layers=1, mode="lstm",
+                     name="lstm")
+    fc = mx.sym.FullyConnected(mx.sym.Reshape(rnn, shape=(-1, H)),
+                               num_hidden=V, name="decoder")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def run(time_major, args, data_np, label_np):
+    T, B = args.seq_len, args.batch_size
+    shape = (T, B) if time_major else (B, T)
+    x = data_np if time_major else data_np.T
+    mod = mx.mod.Module(lm_symbol(time_major, T, args.vocab, args.embed,
+                                  args.hidden),
+                        context=mx.cpu() if not mx.context.num_tpus()
+                        else mx.tpu())
+    mod.bind(data_shapes=[("data", shape)],
+             label_shapes=[("softmax_label", (T * B,))])
+    np.random.seed(0)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                            label=[mx.nd.array(label_np)])
+    mod._step(batch)  # compile
+    mx.nd.waitall()
+    t0 = time.time()
+    for _ in range(args.steps):
+        mod._step(batch)
+    out = float(mod.get_outputs()[0].asnumpy().ravel()[0])  # sync
+    dt = time.time() - t0
+    assert np.isfinite(out)
+    return args.steps * T * B / dt, mod
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=500)
+    p.add_argument("--embed", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, args.vocab,
+                       (args.seq_len, args.batch_size)).astype(np.float32)
+    label = rng.randint(0, args.vocab,
+                        args.seq_len * args.batch_size).astype(np.float32)
+
+    tm_rate, tm_mod = run(True, args, data, label)
+    bm_rate, bm_mod = run(False, args, data, label)
+    print("time-major: %.0f tokens/s   batch-major: %.0f tokens/s "
+          "(ratio %.2fx)" % (tm_rate, bm_rate, tm_rate / bm_rate))
+    # the two layouts train the SAME model (identical init via the seeded
+    # initializer): final params must agree up to reassociation noise
+    tm_args, _ = tm_mod.get_params()
+    bm_args, _ = bm_mod.get_params()
+    for name in tm_args:
+        np.testing.assert_allclose(tm_args[name].asnumpy(),
+                                   bm_args[name].asnumpy(),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+    assert tm_rate > 0 and bm_rate > 0
+    print("RNN TIME-MAJOR OK")
+
+
+if __name__ == "__main__":
+    main()

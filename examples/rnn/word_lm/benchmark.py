@@ -7,9 +7,11 @@ the classic Zaremba et al. setup the reference's word_lm example trains).
 The fused RNN op dispatches to the Pallas fused-LSTM kernel on TPU, with
 the Pallas backward for training.
 
-Measurement discipline matches examples/image-classification/benchmark.py:
-K steps chained in one fori_loop dispatch, calls chained through the params
-carry, one scalar read at the end (bench.py sync rationale).
+Every measured step is the FRAMEWORK's own train path —
+`Module._step_scan`: symbolic Embedding -> fused RNN -> decoder ->
+SoftmaxOutput, fwd+bwd+SGD fused per step, K steps per `lax.scan`
+dispatch (`Module.fit(batches_per_dispatch=K)`'s engine), so per-dispatch
+tunnel latency doesn't hide sustained device throughput.
 """
 from __future__ import print_function
 
@@ -35,102 +37,71 @@ def main():
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
-    p.add_argument("--steps-per-call", type=int, default=20)
+    p.add_argument("--batches-per-dispatch", type=int, default=20)
     p.add_argument("--num-calls", type=int, default=4)
     p.add_argument("--lr", type=float, default=1.0)
     args = p.parse_args()
 
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
     import mxnet_tpu as mx
-    from mxnet_tpu import gluon
-    from mxnet_tpu.gluon import nn, rnn
+    from mxnet_tpu.io import DataBatch
 
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     T, B, V = args.seq_len, args.batch_size, args.vocab
+    H, E = args.num_hidden, args.num_embed
 
-    class PTBModel(gluon.HybridBlock):
-        def __init__(self, **kwargs):
-            super().__init__(**kwargs)
-            with self.name_scope():
-                self.embed = nn.Embedding(V, args.num_embed)
-                self.lstm = rnn.LSTM(args.num_hidden,
-                                     num_layers=args.num_layers,
-                                     layout="TNC",
-                                     input_size=args.num_embed)
-                self.decoder = nn.Dense(V, flatten=False,
-                                        in_units=args.num_hidden)
+    data = mx.sym.Variable("data")                    # (T, B) token ids
+    emb = mx.sym.Embedding(data, input_dim=V, output_dim=E, name="embed")
+    rnn = mx.sym.RNN(emb, state_size=H, num_layers=args.num_layers,
+                     mode="lstm", name="lstm")        # (T, B, H)
+    dec = mx.sym.FullyConnected(mx.sym.Reshape(rnn, shape=(-1, H)),
+                                num_hidden=V, name="decoder")
+    net = mx.sym.SoftmaxOutput(dec, name="softmax")
 
-        def hybrid_forward(self, F, x):
-            e = self.embed._forward_impl(x)
-            h = self.lstm._forward_impl(e)
-            return self.decoder._forward_impl(h)
-
-    net = PTBModel()
-    net.initialize(mx.init.Xavier(), ctx=ctx)
-    net.hybridize()
+    mod = mx.mod.Module(net, context=ctx)
+    type_dict = None
+    if args.dtype != "float32":
+        type_dict = {p_: args.dtype for p_ in mod._param_names}
+    mod.bind(data_shapes=[("data", (T, B))],
+             label_shapes=[("softmax_label", (T * B,))],
+             type_dict=type_dict)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr})
 
     rng = np.random.RandomState(0)
-    x_np = rng.randint(0, V, (T, B)).astype(np.int32)
-    y_np = rng.randint(0, V, (T, B)).astype(np.int32)
-    x0 = mx.nd.array(x_np, ctx=ctx, dtype="int32")
-    net(x0)  # materialize params + build the cached jit
+    K = args.batches_per_dispatch
+    batches = [DataBatch(
+        data=[mx.nd.array(rng.randint(0, V, (T, B)).astype(np.float32),
+                          ctx=ctx)],
+        label=[mx.nd.array(rng.randint(0, V, T * B).astype(np.float32),
+                           ctx=ctx)]) for _ in range(K)]
 
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    names = net._param_order
-    params_nd = net.collect_params()
-    params = tuple(params_nd[n].data()._data.astype(dtype)
-                   if jnp.issubdtype(params_nd[n].data()._data.dtype,
-                                     jnp.floating) else
-                   params_nd[n].data()._data for n in names)
-    cached = net._cached_jit
-    key = jax.random.PRNGKey(0)
-
-    dev = ctx.jax_device()
-    xb = jax.device_put(jnp.asarray(x_np), dev)
-    yb = jax.device_put(jnp.asarray(y_np), dev)
-
-    def loss_fn(pv, xv, yv):
-        logits = cached(pv, key, True, xv)[0][0].astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, -1)
-        return -jnp.mean(jnp.take_along_axis(
-            logp.reshape(-1, V), yv.reshape(-1)[:, None], 1))
-
-    k = args.steps_per_call
-    lr = args.lr
-
-    @jax.jit
-    def k_steps(pv, xv, yv):
-        def body(i, carry):
-            pv, _ = carry
-            xi = jnp.roll(xv, i, axis=1)
-            loss, g = jax.value_and_grad(loss_fn)(pv, xi, yv)
-            pv = tuple(p - lr * gg.astype(p.dtype) if gg is not None else p
-                       for p, gg in zip(pv, g))
-            return pv, loss
-        return lax.fori_loop(0, k, body, (pv, jnp.float32(0)))
-
-    print("compiling %d-step LSTM train program..." % k, flush=True)
+    print("compiling %d-step scanned Module LSTM program..." % K,
+          flush=True)
     t0 = time.time()
-    params, loss = k_steps(params, xb, yb)
-    float(loss)
+    if K > 1:
+        out = mod._step_scan(batches)
+        assert out is not False, "fused scan plan unavailable"
+    else:
+        mod._step(batches[0])
+    float(np.asarray(mod.get_outputs()[0].asnumpy()).ravel()[0])
     compile_s = time.time() - t0
     print("compiled in %.1fs" % compile_s, flush=True)
 
     calls = max(1, args.num_calls)
     t0 = time.time()
     for _ in range(calls):
-        params, loss = k_steps(params, xb, yb)
-    lv = float(loss)
+        if K > 1:
+            mod._step_scan(batches)
+        else:
+            mod._step(batches[0])
+    last = float(np.asarray(mod.get_outputs()[0].asnumpy()).ravel()[0])
     dt = time.time() - t0
-    rate = calls * k * B * T / dt
-    print("final loss %.4f" % lv, flush=True)
+    rate = calls * K * B * T / dt
+    assert np.isfinite(last)
     print("PTB LSTM %dx%d vocab %d dtype %s batch %d seq %d: "
-          "%.0f tokens/s train (compile %.1fs)"
-          % (args.num_layers, args.num_hidden, V, args.dtype, B, T,
-             rate, compile_s))
+          "%.0f tokens/s train via Module._step_scan (compile %.1fs)"
+          % (args.num_layers, H, V, args.dtype, B, T, rate, compile_s))
 
 
 if __name__ == "__main__":

@@ -161,7 +161,7 @@ def test_quantization_example():
 
 def test_ctc_ocr():
     r = _run("ctc/train_ctc_ocr.py", "--num-examples", "800",
-             "--num-epochs", "25", timeout=600)
+             "--num-epochs", "25", timeout=1200)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "sequence accuracy" in r.stdout
 
@@ -229,3 +229,33 @@ def test_profiler_example():
     r = _run("profiler/profiler_example.py")
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "PROFILER EXAMPLE OK" in r.stdout
+
+
+def test_captcha_multihead():
+    r = _run("captcha/train_captcha.py", "--num-epochs", "6", timeout=600)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "CAPTCHA OK" in r.stdout
+
+
+def test_lstnet_forecast():
+    r = _run("multivariate_time_series/train_lstnet.py", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "LSTNET FORECAST OK" in r.stdout
+
+
+def test_sgld_posterior():
+    r = _run("bayesian-methods/sgld_regression.py", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "SGLD OK" in r.stdout
+
+
+def test_dsd_training():
+    r = _run("dsd/train_dsd.py", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "DSD OK" in r.stdout
+
+
+def test_rnn_time_major():
+    r = _run("rnn-time-major/readme_bench.py", "--steps", "10", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "RNN TIME-MAJOR OK" in r.stdout
