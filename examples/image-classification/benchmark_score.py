@@ -65,7 +65,11 @@ def score(network, batch_size, ctx, image=224, iters=20, dtype="float32"):
         return lax.fori_loop(0, iters, body, acc0)
 
     calls = 4
-    float(loop(params, x._data, jnp.float32(0)))  # compile
+    # warm BOTH accumulator signatures: the seed is a weak-typed scalar,
+    # the chained value is a strong device scalar — jax compiles each
+    # once, and the second compile must not land inside the timed region
+    acc = loop(params, x._data, jnp.float32(0))
+    float(loop(params, x._data, acc))
     t0 = time.time()
     acc = jnp.float32(0)
     for _ in range(calls):

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""ONNX workflow example (reference example/onnx: import an ONNX model and
+run inference). The environment ships without the `onnx` package, so this
+example demonstrates the two halves that don't need it:
+
+- the native symbol-JSON + params export/import round trip (the exchange
+  format the framework owns), and
+- the ONNX node translators applied directly (what `import_model` runs
+  under the hood once `onnx` deserializes the protobuf)."""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    # native export/import round trip via gluon -> symbol JSON + params
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "net")
+        net.export(prefix)
+        sym, args, auxs = mx.model.load_checkpoint(prefix, 0)
+        mod = mx.mod.Module(sym, label_names=None, context=mx.cpu())
+        mod.bind(data_shapes=[("data", x.shape)], for_training=False)
+        mod.set_params(args, auxs)
+        mod.forward(mx.io.DataBatch(data=[x], label=None), is_train=False)
+        got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    print("export/import round trip ok")
+
+    # the ONNX translators, applied as import_model would
+    import importlib
+    om = importlib.import_module("mxnet_tpu.contrib.onnx.import_model")
+    data = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+
+    class Proto:
+        _params = {"w": mx.nd.ones((4, 3, 3, 3))}
+
+    conv = om._CONVERT_MAP["Conv"]({"kernel_shape": (3, 3),
+                                    "pads": (1, 1, 1, 1)}, [data, w], Proto)
+    relu = om._CONVERT_MAP["Relu"]({}, [conv], Proto)
+    out = relu.eval(x=mx.nd.ones((1, 3, 8, 8)),
+                    w=mx.nd.ones((4, 3, 3, 3)))[0]
+    assert out.shape == (1, 4, 8, 8)
+    print("onnx translator chain ok")
+
+    try:
+        mx.contrib.onnx.import_model("model.onnx")
+    except ImportError as e:
+        print("(full .onnx files need the `onnx` package: %s)"
+              % str(e)[:50])
+    except (IOError, OSError) as e:  # onnx installed, file absent
+        print("(onnx present; no model file to import: %s)" % str(e)[:50])
+    print("ONNX EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -259,3 +259,27 @@ def test_rnn_time_major():
     r = _run("rnn-time-major/readme_bench.py", "--steps", "10", timeout=900)
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "RNN TIME-MAJOR OK" in r.stdout
+
+
+def test_module_walkthrough():
+    r = _run("module/mod_walkthrough.py", timeout=600)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "MODULE WALKTHROUGH OK" in r.stdout
+
+
+def test_python_howto():
+    r = _run("python-howto/data_and_ops.py", timeout=600)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "PYTHON HOWTO OK" in r.stdout
+
+
+def test_memcost_remat():
+    r = _run("memcost/memonger_demo.py", timeout=600)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "MEMCOST REMAT OK" in r.stdout
+
+
+def test_onnx_roundtrip_example():
+    r = _run("onnx/roundtrip.py", timeout=600)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "ONNX EXAMPLE OK" in r.stdout

@@ -7,8 +7,12 @@ one JSON line per op for regression diffing.
 Run on the chip (plain `python tools/perf/op_bench.py`) for real numbers,
 or `--preset tiny` on CPU for a smoke sweep. Measurement discipline: each
 op compiles once (warmup), then N timed iterations end with ONE fence
-(`test_utils.check_speed` semantics — the chained dispatches share a
-single readback barrier, so tunnel latency doesn't bias per-op time).
+(`test_utils.check_speed` semantics).
+
+Relay caveat: behind the axon tunnel every dispatch costs ~20ms host-side,
+which floors per-iter numbers — read the table RELATIVELY (subtract the
+cheapest op's time as the dispatch floor) or run on a directly-attached
+chip for absolute microseconds.
 """
 from __future__ import annotations
 
