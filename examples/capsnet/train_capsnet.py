@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Capsule network (reference example/capsnet: Sabour et al. — primary
+capsules from conv features, digit capsules via 3 iterations of dynamic
+routing-by-agreement, margin loss on capsule lengths).
+
+TPU-native: the routing loop is a FIXED 3-iteration unrolled loop of
+batched matmuls + softmax — exactly the compiler-friendly control flow
+XLA wants (the reference runs it as imperative NDArray ops per batch).
+The whole model trains under gluon autograd + Trainer."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def squash(F, s, axis=-1, eps=1e-7):
+    n2 = F.sum(s * s, axis=axis, keepdims=True)
+    return s * (n2 / (1 + n2)) / F.sqrt(n2 + eps)
+
+
+class CapsNet(gluon.HybridBlock):
+    def __init__(self, n_classes=4, prim_caps=8, prim_dim=4, digit_dim=8,
+                 routing_iters=3, **kw):
+        super().__init__(**kw)
+        self.n_classes = n_classes
+        self.prim_caps = prim_caps
+        self.prim_dim = prim_dim
+        self.digit_dim = digit_dim
+        self.routing_iters = routing_iters
+        with self.name_scope():
+            self.conv = nn.Conv2D(16, 5, strides=2, activation="relu")
+            self.prim = nn.Conv2D(prim_caps * prim_dim, 3, strides=2)
+            # routing weights W: (prim_total, n_classes, digit_dim, prim_dim)
+            # built lazily on first forward (prim_total needs the map
+            # size); boxed in a list so attribute assignment doesn't
+            # auto-forward it as a hybrid_forward kwarg
+            self._W_box = []
+
+    def hybrid_forward(self, F, x):
+        h = self.conv(x)
+        p = self.prim(h)                       # (B, caps*dim, H, W)
+        B = p.shape[0]
+        u = p.reshape((B, self.prim_dim, -1))  # (B, dim, caps_total)
+        u = F.transpose(u, axes=(0, 2, 1))     # (B, caps_total, dim)
+        u = squash(F, u)
+        n_prim = u.shape[1]
+        if not self._W_box:
+            # lazy routing-weight parameter (reference builds it from the
+            # primary-caps map size the same way)
+            w = self.params.get("routing_weight",
+                                shape=(n_prim, self.n_classes,
+                                       self.digit_dim, self.prim_dim),
+                                init=mx.init.Normal(0.05),
+                                allow_deferred_init=False)
+            w.initialize()
+            self._W_box.append(w)
+        W = self._W_box[0].data()
+        # u_hat[b,i,j,:] = W[i,j] @ u[b,i,:] via broadcasting (B,i,j,D,d)
+        u_b = F.expand_dims(F.expand_dims(u, 2), 3)      # (B,i,1,1,d)
+        W_b = F.expand_dims(W, 0)                        # (1,i,j,D,d)
+        u_hat = F.sum(W_b * u_b, axis=-1)                # (B,i,j,D)
+        b_ij = F.zeros((u.shape[0], n_prim, self.n_classes))
+        for _ in range(self.routing_iters):       # fixed unrolled routing
+            c = F.softmax(b_ij, axis=2)           # coupling coeffs
+            s = F.sum(F.expand_dims(c, -1) * u_hat, axis=1)  # (B, cls, D)
+            v = squash(F, s)
+            b_ij = b_ij + F.sum(u_hat * F.expand_dims(v, 1), axis=-1)
+        return F.sqrt(F.sum(v * v, axis=-1) + 1e-7)  # capsule lengths
+
+
+def margin_loss(F, lengths, onehot, m_pos=0.9, m_neg=0.1, lam=0.5):
+    pos = onehot * F.square(F.maximum(m_pos - lengths, 0.0))
+    neg = (1 - onehot) * F.square(F.maximum(lengths - m_neg, 0.0))
+    return F.sum(pos + lam * neg, axis=1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--classes", type=int, default=4)
+    p.add_argument("--num-epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.002)
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.rand(args.num_examples, 1, 20, 20).astype(np.float32) * 0.2
+    y = rng.randint(0, args.classes, args.num_examples)
+    for i, c in enumerate(y):   # class-dependent oriented bar
+        r = 3 + c * 4
+        X[i, 0, r:r + 3, 2:18] += 0.8
+
+    net = CapsNet(n_classes=args.classes)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    bs = args.batch_size
+    eye = np.eye(args.classes, dtype=np.float32)
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        for i in range(0, len(X), bs):
+            xb = mx.nd.array(X[i:i + bs])
+            ob = mx.nd.array(eye[y[i:i + bs]])
+            with autograd.record():
+                lengths = net(xb)
+                loss = margin_loss(mx.nd, lengths, ob).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print("epoch %d margin loss %.4f" % (epoch, tot / (len(X) // bs)),
+              flush=True)
+
+    correct = 0
+    for i in range(0, len(X), bs):
+        lengths = net(mx.nd.array(X[i:i + bs])).asnumpy()
+        correct += (lengths.argmax(1) == y[i:i + bs]).sum()
+    acc = correct / len(X)
+    print("capsule-length accuracy %.3f" % acc)
+    assert acc > 0.9, acc
+    print("CAPSNET OK")
+
+
+if __name__ == "__main__":
+    main()

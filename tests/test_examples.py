@@ -283,3 +283,15 @@ def test_onnx_roundtrip_example():
     r = _run("onnx/roundtrip.py", timeout=600)
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "ONNX EXAMPLE OK" in r.stdout
+
+
+def test_capsnet_routing():
+    r = _run("capsnet/train_capsnet.py", "--num-epochs", "6", timeout=1200)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "CAPSNET OK" in r.stdout
+
+
+def test_deep_embedded_clustering():
+    r = _run("deep-embedded-clustering/dec.py", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "DEC OK" in r.stdout
