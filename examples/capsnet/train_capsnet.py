@@ -104,8 +104,13 @@ def main():
 
     net = CapsNet(n_classes=args.classes)
     net.initialize(mx.init.Xavier())
+    # one forward MATERIALIZES the lazily-shaped routing weights BEFORE
+    # the Trainer snapshots collect_params() — otherwise the routing
+    # transform would silently stay frozen at its init values
+    net(mx.nd.zeros((1, 1, 20, 20)))
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})
+    assert any("routing" in k for k in net.collect_params()),         "routing weights must be registered before the Trainer"
     bs = args.batch_size
     eye = np.eye(args.classes, dtype=np.float32)
     for epoch in range(args.num_epochs):

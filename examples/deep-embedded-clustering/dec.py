@@ -91,10 +91,8 @@ def main():
     mu.set_data(mx.nd.array(np.stack(mu0)))
 
     # phase 2: KL(P||Q) refinement of encoder + centroids
-    dec_params = gluon.ParameterDict()
-    dec_params.update(enc.collect_params())
-    dec_params._params["centroids_weight"] = mu
-    tr2 = gluon.Trainer(dec_params, "adam", {"learning_rate": 0.01})
+    tr2 = gluon.Trainer(list(enc.collect_params().values()) + [mu],
+                        "adam", {"learning_rate": 0.01})
     for epoch in range(args.dec_epochs):
         q_full = soft_assign(mx.nd, enc(mx.nd.array(X)),
                              mu.data()).asnumpy()
