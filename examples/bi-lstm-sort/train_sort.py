@@ -15,6 +15,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import numpy as np
 import mxnet_tpu as mx
+
+np.random.seed(0)  # initializers draw from numpy's global RNG; deterministic smoke runs
 from mxnet_tpu import autograd, gluon
 
 

@@ -20,6 +20,8 @@ import numpy as np
 
 import mxnet_tpu as mx
 
+np.random.seed(0)  # initializers draw from numpy's global RNG; deterministic smoke runs
+
 N_DIGITS = 3
 N_CLASSES = 10
 

@@ -22,6 +22,8 @@ import numpy as np
 
 import mxnet_tpu as mx
 
+np.random.seed(0)  # initializers draw from numpy's global RNG; deterministic smoke runs
+
 
 def fcn_symbol(num_classes=2):
     data = mx.sym.Variable("data")

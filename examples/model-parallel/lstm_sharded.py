@@ -44,6 +44,8 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import rnn, nn
 
+    np.random.seed(0)  # initializers draw from numpy's global RNG
+
     devices = jax.devices()
     mp = min(args.mp, len(devices))
     dp = args.dp or max(1, len(devices) // mp)

@@ -15,6 +15,8 @@ import numpy as np
 
 import mxnet_tpu as mx
 
+np.random.seed(0)  # initializers draw from numpy's global RNG; deterministic smoke runs
+
 
 def main():
     rng = np.random.RandomState(0)

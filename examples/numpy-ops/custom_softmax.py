@@ -19,6 +19,8 @@ import numpy as np
 
 import mxnet_tpu as mx
 
+np.random.seed(0)  # initializers draw from numpy's global RNG; deterministic smoke runs
+
 
 class NumpySoftmax(mx.operator.CustomOp):
     def forward(self, is_train, req, in_data, out_data, aux):

@@ -45,6 +45,7 @@ def main():
     p.add_argument("--lr", type=float, default=0.02)
     args = p.parse_args()
 
+    np.random.seed(0)  # initializers draw from the global RNG
     rng = np.random.RandomState(0)
     U = rng.randn(args.num_users, args.rank).astype("f") * 0.8
     V = rng.randn(args.num_items, args.rank).astype("f") * 0.8

@@ -23,6 +23,8 @@ import numpy as np
 
 import mxnet_tpu as mx
 
+np.random.seed(0)  # initializers draw from numpy's global RNG; deterministic smoke runs
+
 BLANK_FIRST = 0  # blank label id (CTCLoss blank_label='first')
 
 

@@ -181,3 +181,64 @@ def test_step_scan_metric_counts_every_batch():
             batches_per_dispatch=2,
             batch_end_callback=lambda p: seen.append(p.nbatch))
     assert seen == [0, 1, 2]  # 3 batches -> one scan(2) + one plain step
+
+
+def test_dp_with_bf16_type_dict():
+    """SPMD dp composes with bf16 binding (type_dict)."""
+    X, y = _make_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    td = {"data": "bfloat16"}
+    td.update({p_: "bfloat16" for p_ in mod._param_names})
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             type_dict=td)
+    np.random.seed(0)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+    for _ in range(3):
+        it.reset()
+        for batch in it:
+            mod._step(batch)
+    w = mod._exec.arg_dict["fc1_weight"]
+    assert str(w.dtype) == "bfloat16"
+    assert len(w._data.sharding.device_set) == 8
+    out = mod.get_outputs()[0].asnumpy().astype(np.float32)
+    assert np.isfinite(out).all()
+
+
+def test_dp_with_bucketing_module():
+    """BucketingModule shares the dp-sharded parameter arrays across
+    bucket executors (shared_exec carries the shardings)."""
+    ctxs = [mx.cpu(i) for i in range(8)]
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(
+            mx.sym.Reshape(data, shape=(-1, 4)), num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=ctxs)
+    mod.bind(data_shapes=[("data", (16, 8, 4))],
+             label_shapes=[("softmax_label", (16 * 8,))])
+    np.random.seed(0)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    rng = np.random.RandomState(0)
+    for key in (8, 4, 8):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.rand(16, key, 4).astype(np.float32))],
+            label=[mx.nd.array((rng.rand(16 * key) * 3)
+                               .astype(np.float32))],
+            bucket_key=key,
+            provide_data=[("data", (16, key, 4))],
+            provide_label=[("softmax_label", (16 * key,))], pad=0)
+        mod.forward_backward(batch)
+        mod.update()
+    w = mod._curr_module._exec.arg_dict["fc1_weight"]
+    assert len(w._data.sharding.device_set) == 8  # stayed on the mesh
