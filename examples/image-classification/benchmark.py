@@ -125,16 +125,18 @@ def main():
     dt = time.time() - t0
     rate = calls * K * batch / dt
     assert np.isfinite(last)
-    # MFU: fwd FLOPs x3 for fwd+bwd (the optimizer is O(params), noise).
-    # Fwd GFLOPs per image at 224^2 from the standard conv+fc count.
-    FWD_GFLOP = {"resnet50_v1": 4.09, "resnet50_v2": 4.09,
-                 "resnet101_v1": 7.8, "resnet152_v1": 11.5,
-                 "alexnet": 0.72, "inception_v3": 5.7, "vgg16": 15.5}
+    # MFU: fwd MACs x2 (flops per MAC) x3 (fwd + bwd costs ~2x fwd; the
+    # optimizer is O(params), noise). The commonly-quoted "4.09 GFLOPs"
+    # for ResNet-50 is actually GMACs (torchvision convention) — true
+    # FLOPs are double that.
+    FWD_GMAC = {"resnet50_v1": 4.09, "resnet50_v2": 4.09,
+                "resnet101_v1": 7.8, "resnet152_v1": 11.5,
+                "alexnet": 0.72, "inception_v3": 5.7, "vgg16": 15.5}
     peak_tflops = 197.0 if args.dtype == "bfloat16" else 49.0  # v5e chip
-    gflop = FWD_GFLOP.get(args.model)
+    gmac = FWD_GMAC.get(args.model)
     mfu = ""
-    if gflop and "224" in args.image_shape:
-        mfu_val = rate * 3 * gflop * 1e9 / (peak_tflops * 1e12)
+    if gmac and "224" in args.image_shape:
+        mfu_val = rate * 3 * 2 * gmac * 1e9 / (peak_tflops * 1e12)
         mfu = ", MFU %.1f%% of %.0f TF/s" % (100 * mfu_val, peak_tflops)
     print("model %s dtype %s batch %d: %.1f img/s train via Module._step_scan "
           "(compile %.1fs, %d steps/dispatch x %d calls%s)"

@@ -638,7 +638,14 @@ class Module(BaseModule):
                     body, (grad_args, aux_vals, state_vals, key), stacked)
                 return ga, aux, sv, outs
 
-            donate = (8,) if getattr(self._context[0], "device_type", "cpu") \
+            # donate the optimizer states only — matching _step's policy
+            # (params are NOT donated: user code may hold raw views of the
+            # old weight buffers, and fit() mixes scan and plain steps in
+            # one epoch when the batch count isn't a multiple of K, so the
+            # two paths must give the same buffer-lifetime guarantee;
+            # donating params measured ~1% anyway). CPU lacks donation.
+            donate = (8,) if getattr(self._context[0], "device_type",
+                                     "cpu") \
                 not in ("cpu", "cpu_pinned", "cpu_shared") else ()
             scan_fn = jax.jit(scan_step, donate_argnums=donate)
             if self._scan_plans is None:

@@ -368,8 +368,11 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (reference tensor/dot-inl.h). CSR x dense runs
     O(nnz * cols) over the compact payload: gather the needed rhs rows and
     segment-sum into output rows — gather + MXU-friendly math, no dense lhs.
-    Other combinations fall back to the dense path."""
+    Other combinations — and any call under autograd.record(), which needs
+    the tape the op dispatcher builds — use the dense op path."""
+    from .. import autograd as _ag
     if isinstance(lhs, CSRNDArray) and lhs.has_compact() and \
+            not _ag.is_recording() and \
             not transpose_a and not transpose_b and \
             isinstance(rhs, NDArray) and rhs.ndim == 2:
         aux = lhs._ensure_aux()

@@ -146,3 +146,60 @@ def test_async_staleness_bound_blocks_runaway_worker():
         assert done.wait(timeout=10)  # unblocked
     finally:
         srv.shutdown()
+
+
+MODULE_WORKER = r"""
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+
+rank = int(sys.argv[1])
+epochs = int(sys.argv[2])
+np.random.seed(42)  # same data/init on both workers
+rng = np.random.RandomState(0)
+X = rng.randn(128, 10).astype(np.float32)
+W = rng.randn(10, 3).astype(np.float32)
+y = X.dot(W).argmax(1).astype(np.float32)
+it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=epochs, kvstore="dist_async", optimizer="sgd",
+        initializer=mx.init.Xavier(),
+        optimizer_params={"learning_rate": 0.2, "rescale_grad": 1.0 / 32})
+it.reset()
+m = mx.metric.Accuracy()
+mod.score(it, m)
+print("WORKER %d ACC %.3f" % (rank, m.get()[1]), flush=True)
+"""
+
+
+def test_module_fit_against_async_ps(tmp_path):
+    """Module.fit(kvstore='dist_async') trains end-to-end against the
+    async parameter server: two workers, server-side SGD updates, both
+    reach high accuracy on the shared model."""
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        script = tmp_path / "mw.py"
+        script.write_text(MODULE_WORKER)
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PALLAS_AXON_POOL_IPS="", PYTHONPATH=REPO,
+                       MXNET_PS_HOST="127.0.0.1", MXNET_PS_PORT=str(port),
+                       MXNET_PS_RANK=str(rank), MXNET_PS_NUM_WORKERS="2")
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script), str(rank), "12"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        accs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, out
+            accs.append(float(out.split("ACC")[1].split()[0]))
+        assert all(a > 0.9 for a in accs), (accs,)
+    finally:
+        srv.shutdown()

@@ -251,3 +251,20 @@ def test_cast_storage_rs_csr_unsorted_indices():
                                rs.todense().asnumpy())
     np.testing.assert_allclose(csr.todense().asnumpy()[0], 2.0)
     np.testing.assert_allclose(csr.todense().asnumpy()[3], 1.0)
+
+
+def test_sparse_dot_differentiable_under_record():
+    """Under autograd.record() sparse.dot must produce real gradients
+    (the compact fast path bypasses the tape, so recording falls back to
+    the op dispatcher)."""
+    csr = sparse.csr_matrix(DENSE)
+    rhs = mx.nd.array(np.random.RandomState(0).rand(3, 5).astype("f"))
+    rhs.attach_grad()
+    with mx.autograd.record():
+        out = sparse.dot(csr, rhs)
+        loss = out.sum()
+    loss.backward()
+    g = rhs.grad.asnumpy()
+    # d(sum(A@R))/dR = A^T @ ones
+    want = DENSE.T.dot(np.ones((4, 5), "f"))
+    np.testing.assert_allclose(g, want, rtol=1e-5)
