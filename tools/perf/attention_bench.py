@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Long-context attention benchmark: the Pallas flash-attention kernel
+(`contrib.flash_attention`, ops/pallas_kernels.py) at sequence lengths the
+reference cannot express (its attention materializes the full T x T score
+matrix; 32k x 32k f32 scores = 4 GB per head — OOM long before this).
+
+Reports sustained attention TFLOP/s per sequence length with the chained
+single-readback discipline (bench.py rationale). FLOPs = 4*B*H*T^2*D
+(QK^T + PV, 2 FLOPs/MAC each); causal halves it."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-lens", nargs="+", type=int,
+                   default=[4096, 16384, 32768])
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--iters", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    dev = ctx.jax_device()
+    B, H, D = args.batch, args.heads, args.head_dim
+
+    for T in args.seq_lens:
+        rng = np.random.RandomState(0)
+        q, k, v = (jax.device_put(
+            (rng.randn(B, T, H, D) * 0.05).astype(args.dtype), dev)
+            for _ in range(3))
+
+        iters = args.iters
+
+        @jax.jit
+        def loop(q, k, v, acc0):
+            def body(i, acc):
+                qi = jnp.roll(q, i, axis=1)  # data-dependent on i
+                o = flash_attention(qi, k, v, causal=args.causal)
+                return acc + o.ravel()[0].astype(jnp.float32)
+            return lax.fori_loop(0, iters, body, acc0)
+
+        # warm both accumulator placements (see benchmark_score.py)
+        acc = loop(q, k, v, jnp.float32(0))
+        float(loop(q, k, v, acc))
+        t0 = time.time()
+        acc = jnp.float32(0)
+        for _ in range(2):
+            acc = loop(q, k, v, acc)
+        float(acc)
+        dt_s = time.time() - t0
+        n = 2 * iters
+        flops = 4.0 * B * H * T * T * D * (0.5 if args.causal else 1.0)
+        tflops = flops * n / dt_s / 1e12
+        ms = dt_s / n * 1e3
+        print("T=%6d  %s  causal=%s: %7.2f ms/attention  %6.1f TFLOP/s"
+              % (T, args.dtype, args.causal, ms, tflops), flush=True)
+
+
+if __name__ == "__main__":
+    main()
