@@ -222,3 +222,76 @@ def test_sparse_row_update_on_chip():
     out = w.asnumpy()
     np.testing.assert_allclose(out[idx], 0.5)
     np.testing.assert_allclose(out[[0, 5, 100_000]], 1.0)
+
+
+def test_core_op_consistency_vs_cpu():
+    """The reference re-runs the op suite on the accelerator and compares
+    against CPU (tests/python/gpu/test_operator_gpu.py:check_consistency).
+    Sweep the hot op families fwd+bwd on the chip vs the CPU oracle."""
+    ctx = _tpu_ctx()
+    rng = np.random.RandomState(0)
+
+    def fwd_bwd(sym, shapes, device_ctx):
+        exe = sym.simple_bind(device_ctx, **shapes)
+        for name, arr in inputs.items():
+            if name in exe.arg_dict:
+                exe.arg_dict[name][:] = arr
+        exe.forward_backward()
+        outs = [o.asnumpy() for o in exe.outputs]
+        grads = {n: g.asnumpy() for n, g in exe.grad_dict.items()
+                 if g is not None}
+        return outs, grads
+
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    cases = [
+        ("conv3x3", mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                                       num_filter=8, name="c"),
+         {"data": (2, 3, 12, 12)}),
+        ("fc", mx.sym.FullyConnected(data, num_hidden=16, name="f"),
+         {"data": (4, 10)}),
+        ("bn", mx.sym.BatchNorm(data, fix_gamma=False, name="b"),
+         {"data": (4, 6, 8, 8)}),
+        ("pool", mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2),
+                                pool_type="max"),
+         {"data": (2, 4, 8, 8)}),
+        ("softmax", mx.sym.softmax(data, axis=-1), {"data": (4, 11)}),
+        ("dot", mx.sym.dot(data, w), {"data": (8, 8), "w": (8, 8)}),
+        ("tanh", mx.sym.tanh(data), {"data": (3, 7)}),
+        ("layernorm", mx.sym.LayerNorm(data, mx.sym.Variable("g"),
+                                       mx.sym.Variable("be")),
+         {"data": (4, 16), "g": (16,), "be": (16,)}),
+        ("deconv", mx.sym.Deconvolution(data, kernel=(4, 4), stride=(2, 2),
+                                        pad=(1, 1), num_filter=4,
+                                        name="d"),
+         {"data": (2, 3, 8, 8)}),
+        ("embed+take", mx.sym.Embedding(data, w, input_dim=50,
+                                        output_dim=8),
+         {"data": (4, 6), "w": (50, 8)}),
+    ]
+    for name, sym, shapes in cases:
+        inputs = {}
+        for arg, shp in shapes.items():
+            if name == "embed+take" and arg == "data":
+                inputs[arg] = rng.randint(0, 50, shp).astype("f")
+            else:
+                inputs[arg] = (rng.randn(*shp) * 0.5).astype("f")
+        # weights the symbol created internally get random values too
+        probe = sym.simple_bind(mx.cpu(), **shapes)
+        for an in probe.arg_dict:
+            if an not in inputs:
+                inputs[an] = (rng.randn(*probe.arg_dict[an].shape)
+                              * 0.5).astype("f")
+                shapes = dict(shapes)
+        cpu_outs, cpu_grads = fwd_bwd(sym, shapes, mx.cpu())
+        tpu_outs, tpu_grads = fwd_bwd(sym, shapes, ctx)
+        # TPU f32 convs run through bf16 MXU passes by default (XLA's
+        # conv precision), so tolerances reflect bf16-grade numerics —
+        # same allowance the reference's check_consistency gives fp16
+        for a, b in zip(cpu_outs, tpu_outs):
+            np.testing.assert_allclose(b, a, rtol=5e-2, atol=2e-2,
+                                       err_msg="%s fwd" % name)
+        for gname in cpu_grads:
+            np.testing.assert_allclose(tpu_grads[gname], cpu_grads[gname],
+                                       rtol=5e-2, atol=3e-2,
+                                       err_msg="%s grad %s" % (name, gname))
