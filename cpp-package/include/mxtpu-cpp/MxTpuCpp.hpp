@@ -145,6 +145,72 @@ inline Image ImResize(const Image &src, int dh, int dw) {
 }
 
 /*! \brief COCO RLE mask (column-major h*w binary <-> counts) */
+/*! \brief read-only view of one array in an NDList */
+struct NDListEntry {
+  std::string name;
+  std::vector<int64_t> shape;
+  int dtype_flag;          // 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64
+  const void *data;        // owned by the NDList handle
+};
+
+/*! \brief the .params NDArray-list container (reference c_predict_api
+ *  MXNDListCreate + NDArray::Load/Save): load checkpoint parameter files
+ *  written by the Python frontend (byte-exact format) or save new ones. */
+class NDList {
+ public:
+  explicit NDList(const std::string &path) {
+    size_t n = 0;
+    Check(MXTNDListCreateFromFile(path.c_str(), &handle_, &n));
+    count_ = n;
+  }
+  NDList(const char *buf, size_t size) {
+    size_t n = 0;
+    Check(MXTNDListCreate(buf, size, &handle_, &n));
+    count_ = n;
+  }
+  ~NDList() {
+    if (handle_) MXTNDListFree(handle_);
+  }
+  NDList(const NDList &) = delete;
+  NDList &operator=(const NDList &) = delete;
+
+  size_t size() const { return count_; }
+
+  NDListEntry Get(size_t index) const {
+    const char *name;
+    const void *data;
+    const int64_t *shape;
+    uint32_t ndim;
+    int flag;
+    Check(MXTNDListGet(handle_, index, &name, &data, &shape, &ndim, &flag));
+    return NDListEntry{name, std::vector<int64_t>(shape, shape + ndim),
+                       flag, data};
+  }
+
+  static void Save(const std::string &path,
+                   const std::vector<NDListEntry> &entries) {
+    std::vector<const char *> names;
+    std::vector<const void *> datas;
+    std::vector<const int64_t *> shapes;
+    std::vector<uint32_t> ndims;
+    std::vector<int> flags;
+    for (const auto &e : entries) {
+      names.push_back(e.name.c_str());
+      datas.push_back(e.data);
+      shapes.push_back(e.shape.data());
+      ndims.push_back(static_cast<uint32_t>(e.shape.size()));
+      flags.push_back(e.dtype_flag);
+    }
+    Check(MXTNDListSave(path.c_str(), entries.size(), names.data(),
+                        datas.data(), shapes.data(), ndims.data(),
+                        flags.data()));
+  }
+
+ private:
+  NDListHandle handle_ = nullptr;
+  size_t count_ = 0;
+};
+
 class RLE {
  public:
   RLE() = default;

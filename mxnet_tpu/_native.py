@@ -30,6 +30,23 @@ def _declare(lib):
 
     h = c.c_void_p
     sz = c.c_size_t
+    # NDList (.params container)
+    lib.MXTNDListCreate.argtypes = [c.c_char_p, sz, c.POINTER(h),
+                                    c.POINTER(sz)]
+    lib.MXTNDListCreateFromFile.argtypes = [c.c_char_p, c.POINTER(h),
+                                            c.POINTER(sz)]
+    lib.MXTNDListGet.argtypes = [h, sz, c.POINTER(c.c_char_p),
+                                 c.POINTER(c.c_void_p),
+                                 c.POINTER(c.POINTER(c.c_int64)),
+                                 c.POINTER(c.c_uint32),
+                                 c.POINTER(c.c_int)]
+    lib.MXTNDListFree.argtypes = [h]
+    lib.MXTNDListSave.argtypes = [c.c_char_p, sz,
+                                  c.POINTER(c.c_char_p),
+                                  c.POINTER(c.c_void_p),
+                                  c.POINTER(c.POINTER(c.c_int64)),
+                                  c.POINTER(c.c_uint32),
+                                  c.POINTER(c.c_int)]
     lib.MXTRecordIOWriterCreate.argtypes = [c.c_char_p, c.POINTER(h)]
     lib.MXTRecordIOWriterFree.argtypes = [h]
     lib.MXTRecordIOWriterWriteRecord.argtypes = [h, c.c_char_p, sz]

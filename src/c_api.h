@@ -139,6 +139,26 @@ MXTPU_DLL int MXTMaskIoU(const uint32_t *a_counts, const size_t *a_lens,
 MXTPU_DLL int MXTMaskFrPoly(const double *xy, size_t k, int h, int w,
                             uint32_t *out_counts, size_t *out_len);
 
+/* ---- NDArray-list (.params) container (c_predict_api MXNDList* analog,
+ * reference src/c_api/c_predict_api.cc:361; byte-exact with
+ * NDArray::Load/Save). dtype flags: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8
+ * 6=i64. Returned pointers live until MXTNDListFree. ---- */
+typedef void *NDListHandle;
+MXTPU_DLL int MXTNDListCreate(const char *buf, size_t size,
+                              NDListHandle *out, size_t *out_count);
+MXTPU_DLL int MXTNDListCreateFromFile(const char *path, NDListHandle *out,
+                                      size_t *out_count);
+MXTPU_DLL int MXTNDListGet(NDListHandle handle, size_t index,
+                           const char **out_name, const void **out_data,
+                           const int64_t **out_shape, uint32_t *out_ndim,
+                           int *out_dtype_flag);
+MXTPU_DLL int MXTNDListFree(NDListHandle handle);
+MXTPU_DLL int MXTNDListSave(const char *path, size_t count,
+                            const char *const *names,
+                            const void *const *datas,
+                            const int64_t *const *shapes,
+                            const uint32_t *ndims, const int *dtype_flags);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

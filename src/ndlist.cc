@@ -1,0 +1,280 @@
+/*!
+ * \file ndlist.cc
+ * \brief Native reader/writer for the reference `.params` NDArray-list
+ * container (the c_predict_api's MXNDListCreate surface,
+ * reference src/c_api/c_predict_api.cc:361 + NDArray::Load/Save,
+ * src/ndarray/ndarray.cc:1565).
+ *
+ * Layout (little-endian; matches python/mxnet_tpu/ndarray/utils.py which
+ * is byte-exact with the reference):
+ *
+ *   [u64 0x112][u64 reserved][u64 count]
+ *   count x NDArray:
+ *     [u32 0xF993FAC9][i32 stype=0][u32 ndim][i64 shape[ndim]]
+ *     [i32 dev_type][i32 dev_id][i32 dtype_flag][raw data]
+ *     (V1 magic 0xF993FAC8 omits stype; legacy records use
+ *      [u32 ndim][u32 shape[ndim]] with the ndim in the magic slot)
+ *   [u64 n_names] n_names x {[u64 len][bytes]}
+ *
+ * dtype flags: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64 (reference
+ * python/mxnet/base.py _DTYPE_NP_TO_MX).
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "error.h"
+
+namespace mxtpu {
+
+static const uint64_t kListMagic = 0x112;
+static const uint32_t kNDV2Magic = 0xF993FAC9u;
+static const uint32_t kNDV1Magic = 0xF993FAC8u;
+
+static size_t DTypeSize(int flag) {
+  switch (flag) {
+    case 0: return 4;   // float32
+    case 1: return 8;   // float64
+    case 2: return 2;   // float16
+    case 3: return 1;   // uint8
+    case 4: return 4;   // int32
+    case 5: return 1;   // int8
+    case 6: return 8;   // int64
+    default:
+      throw std::runtime_error("unknown dtype flag " +
+                               std::to_string(flag));
+  }
+}
+
+struct NDEntry {
+  std::string name;
+  std::vector<int64_t> shape;
+  int dtype_flag = 0;
+  std::vector<uint8_t> data;
+};
+
+class NDList {
+ public:
+  std::vector<NDEntry> entries;
+};
+
+class Cursor {
+ public:
+  Cursor(const uint8_t *p, size_t n) : p_(p), n_(n), off_(0) {}
+  const uint8_t *Take(size_t n) {
+    // overflow-safe: off_ <= n_ always holds, so compare against the
+    // remainder instead of off_ + n (which can wrap for corrupt sizes)
+    if (n > n_ - off_)
+      throw std::runtime_error("truncated .params payload");
+    const uint8_t *r = p_ + off_;
+    off_ += n;
+    return r;
+  }
+  size_t Remaining() const { return n_ - off_; }
+  template <typename T> T Read() {
+    T v;
+    std::memcpy(&v, Take(sizeof(T)), sizeof(T));
+    return v;
+  }
+
+ private:
+  const uint8_t *p_;
+  size_t n_;
+  size_t off_;
+};
+
+static NDEntry ReadND(Cursor *c) {
+  NDEntry e;
+  uint32_t magic = c->Read<uint32_t>();
+  uint32_t ndim;
+  bool legacy = false;
+  if (magic == kNDV2Magic) {
+    int32_t stype = c->Read<int32_t>();
+    if (stype != 0)
+      throw std::runtime_error("sparse storage in .params not supported");
+    ndim = c->Read<uint32_t>();
+  } else if (magic == kNDV1Magic) {
+    ndim = c->Read<uint32_t>();
+  } else {
+    // legacy: the magic slot IS the ndim; dims are u32
+    ndim = magic;
+    if (ndim > 32)
+      throw std::runtime_error("invalid .params record magic");
+    legacy = true;
+  }
+  if (!legacy && ndim == 0)
+    throw std::runtime_error("uninitialized NDArray record in .params");
+  // a valid record needs at least ndim dim-fields of payload: reject a
+  // corrupt huge ndim BEFORE allocating the shape vector
+  if (static_cast<size_t>(ndim) > c->Remaining() / (legacy ? 4 : 8))
+    throw std::runtime_error("invalid .params record (ndim too large)");
+  e.shape.resize(ndim);
+  size_t count = 1;
+  for (uint32_t i = 0; i < ndim; ++i) {
+    int64_t d = legacy
+        ? static_cast<int64_t>(c->Read<uint32_t>())
+        : c->Read<int64_t>();
+    if (d < 0)
+      throw std::runtime_error("negative dimension in .params record");
+    e.shape[i] = d;
+    // overflow-checked product: a wrapped count would under-size the
+    // data read and hand consumers a shape larger than the buffer
+    if (d != 0 && count > SIZE_MAX / static_cast<size_t>(d))
+      throw std::runtime_error("dimension product overflow in .params");
+    count *= static_cast<size_t>(d);
+  }
+  c->Read<int32_t>();  // context dev_type
+  c->Read<int32_t>();  // context dev_id
+  e.dtype_flag = c->Read<int32_t>();
+  size_t bytes = count * DTypeSize(e.dtype_flag);
+  const uint8_t *src = c->Take(bytes);
+  e.data.assign(src, src + bytes);
+  return e;
+}
+
+static NDList *ParseList(const uint8_t *buf, size_t size) {
+  Cursor c(buf, size);
+  if (c.Read<uint64_t>() != kListMagic)
+    throw std::runtime_error("not a .params NDArray-list file");
+  c.Read<uint64_t>();  // reserved
+  uint64_t count = c.Read<uint64_t>();
+  auto list = new NDList();
+  try {
+    list->entries.resize(count);
+    for (uint64_t i = 0; i < count; ++i) list->entries[i] = ReadND(&c);
+    uint64_t n_names = c.Read<uint64_t>();
+    if (n_names != 0 && n_names != count)
+      throw std::runtime_error("name/array count mismatch in .params");
+    for (uint64_t i = 0; i < n_names; ++i) {
+      uint64_t len = c.Read<uint64_t>();
+      const uint8_t *s = c.Take(len);
+      list->entries[i].name.assign(reinterpret_cast<const char *>(s), len);
+    }
+  } catch (...) {
+    delete list;
+    throw;
+  }
+  return list;
+}
+
+}  // namespace mxtpu
+
+extern "C" {
+
+int MXTNDListCreate(const char *buf, size_t size, NDListHandle *out,
+                    size_t *out_count) {
+  MXT_API_BEGIN()
+  auto list = mxtpu::ParseList(
+      reinterpret_cast<const uint8_t *>(buf), size);
+  *out = list;
+  *out_count = list->entries.size();
+  MXT_API_END()
+}
+
+int MXTNDListCreateFromFile(const char *path, NDListHandle *out,
+                            size_t *out_count) {
+  MXT_API_BEGIN()
+  std::FILE *fp = std::fopen(path, "rb");
+  if (!fp)
+    throw std::runtime_error(std::string("cannot open: ") + path);
+  std::fseek(fp, 0, SEEK_END);
+  long n = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<uint8_t> buf(n > 0 ? static_cast<size_t>(n) : 0);
+  size_t got = buf.empty() ? 0 : std::fread(buf.data(), 1, buf.size(), fp);
+  std::fclose(fp);
+  if (got != buf.size())
+    throw std::runtime_error("short read on .params file");
+  auto list = mxtpu::ParseList(buf.data(), buf.size());
+  *out = list;
+  *out_count = list->entries.size();
+  MXT_API_END()
+}
+
+int MXTNDListGet(NDListHandle handle, size_t index, const char **out_name,
+                 const void **out_data, const int64_t **out_shape,
+                 uint32_t *out_ndim, int *out_dtype_flag) {
+  MXT_API_BEGIN()
+  auto list = static_cast<mxtpu::NDList *>(handle);
+  if (index >= list->entries.size())
+    throw std::runtime_error("NDList index out of range");
+  const auto &e = list->entries[index];
+  *out_name = e.name.c_str();
+  *out_data = e.data.data();
+  *out_shape = e.shape.data();
+  *out_ndim = static_cast<uint32_t>(e.shape.size());
+  *out_dtype_flag = e.dtype_flag;
+  MXT_API_END()
+}
+
+int MXTNDListFree(NDListHandle handle) {
+  MXT_API_BEGIN()
+  delete static_cast<mxtpu::NDList *>(handle);
+  MXT_API_END()
+}
+
+int MXTNDListSave(const char *path, size_t count, const char *const *names,
+                  const void *const *datas, const int64_t *const *shapes,
+                  const uint32_t *ndims, const int *dtype_flags) {
+  MXT_API_BEGIN()
+  // validate EVERYTHING before touching the filesystem: a mid-write
+  // failure would leave a plausible-looking truncated file (possibly
+  // replacing a good checkpoint at the same path)
+  for (size_t i = 0; i < count; ++i) {
+    if (ndims[i] == 0)
+      throw std::runtime_error("cannot serialize a 0-dim NDArray");
+    mxtpu::DTypeSize(dtype_flags[i]);  // throws on unknown flag
+    for (uint32_t d = 0; d < ndims[i]; ++d)
+      if (shapes[i][d] < 0)
+        throw std::runtime_error("negative dimension in NDList entry");
+  }
+  std::FILE *fp = std::fopen(path, "wb");
+  if (!fp)
+    throw std::runtime_error(std::string("cannot open for write: ") + path);
+  struct Closer {
+    std::FILE *fp;
+    ~Closer() { if (fp) std::fclose(fp); }
+  } closer{fp};
+  auto w = [&](const void *p, size_t n) {
+    if (std::fwrite(p, 1, n, fp) != n)
+      throw std::runtime_error("short write on .params file");
+  };
+  auto w64 = [&](uint64_t v) { w(&v, 8); };
+  auto w32 = [&](uint32_t v) { w(&v, 4); };
+  auto wi32 = [&](int32_t v) { w(&v, 4); };
+  w64(mxtpu::kListMagic);
+  w64(0);
+  w64(count);
+  for (size_t i = 0; i < count; ++i) {
+    w32(mxtpu::kNDV2Magic);
+    wi32(0);                       // kDefaultStorage
+    w32(ndims[i]);
+    size_t n = 1;
+    for (uint32_t d = 0; d < ndims[i]; ++d) {
+      int64_t dim = shapes[i][d];
+      w(&dim, 8);
+      n *= static_cast<size_t>(dim);
+    }
+    wi32(1);                       // Context: cpu
+    wi32(0);                       // dev_id 0
+    wi32(dtype_flags[i]);
+    w(datas[i], n * mxtpu::DTypeSize(dtype_flags[i]));
+  }
+  bool have_names = names != nullptr;
+  w64(have_names ? count : 0);
+  if (have_names) {
+    for (size_t i = 0; i < count; ++i) {
+      const char *nm = names[i] ? names[i] : "";
+      uint64_t len = std::strlen(nm);
+      w64(len);
+      w(nm, len);
+    }
+  }
+  MXT_API_END()
+}
+
+}  // extern "C"

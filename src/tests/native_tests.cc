@@ -315,6 +315,61 @@ static void TestCppPackage() {
   CHECK(std::abs(rle.IoU(rle) - 1.0) < 1e-9);
 }
 
+
+static void TestNDList() {
+  std::string path = TempPath("ndlist.params");
+  // write two arrays (f32 matrix + i64 vector) with names
+  float w0[6] = {1.5f, -2.0f, 0.0f, 3.25f, 4.0f, -0.5f};
+  int64_t w1[3] = {7, -8, 9};
+  const int64_t s0[2] = {2, 3};
+  const int64_t s1[1] = {3};
+  const char *names[2] = {"fc_weight", "ids"};
+  const void *datas[2] = {w0, w1};
+  const int64_t *shapes[2] = {s0, s1};
+  const uint32_t ndims[2] = {2, 1};
+  const int flags[2] = {0, 6};
+  CHECK_OK(MXTNDListSave(path.c_str(), 2, names, datas, shapes, ndims,
+                         flags));
+
+  NDListHandle h = nullptr;
+  size_t count = 0;
+  CHECK_OK(MXTNDListCreateFromFile(path.c_str(), &h, &count));
+  CHECK(count == 2);
+  const char *name;
+  const void *data;
+  const int64_t *shape;
+  uint32_t ndim;
+  int flag;
+  CHECK_OK(MXTNDListGet(h, 0, &name, &data, &shape, &ndim, &flag));
+  CHECK(std::string(name) == "fc_weight");
+  CHECK(ndim == 2 && shape[0] == 2 && shape[1] == 3 && flag == 0);
+  CHECK(std::memcmp(data, w0, sizeof(w0)) == 0);
+  CHECK_OK(MXTNDListGet(h, 1, &name, &data, &shape, &ndim, &flag));
+  CHECK(std::string(name) == "ids");
+  CHECK(ndim == 1 && shape[0] == 3 && flag == 6);
+  CHECK(std::memcmp(data, w1, sizeof(w1)) == 0);
+  // out-of-range index errors cleanly
+  CHECK(MXTNDListGet(h, 5, &name, &data, &shape, &ndim, &flag) != 0);
+  CHECK_OK(MXTNDListFree(h));
+
+  // from-buffer parse of the same bytes
+  std::FILE *fp = std::fopen(path.c_str(), "rb");
+  CHECK(fp != nullptr);
+  std::fseek(fp, 0, SEEK_END);
+  long n = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(n);
+  CHECK(std::fread(buf.data(), 1, n, fp) == static_cast<size_t>(n));
+  std::fclose(fp);
+  CHECK_OK(MXTNDListCreate(buf.data(), buf.size(), &h, &count));
+  CHECK(count == 2);
+  CHECK_OK(MXTNDListFree(h));
+  // corrupt magic rejected
+  buf[0] ^= 0x7f;
+  CHECK(MXTNDListCreate(buf.data(), buf.size(), &h, &count) != 0);
+  std::remove(path.c_str());
+}
+
 int main() {
   TestErrorConvention();
   TestRecordIORoundtrip();
@@ -323,6 +378,7 @@ int main() {
   TestMasks();
   TestImagePipeline();
   TestCppPackage();
+  TestNDList();
   if (g_failures) {
     std::fprintf(stderr, "%d/%d checks FAILED\n", g_failures, g_checks);
     return 1;

@@ -20,3 +20,109 @@ def test_native_cpp_suite():
                          capture_output=True, text=True)
     assert run.returncode == 0, run.stdout + run.stderr
     assert "checks passed" in run.stdout
+
+
+def test_ndlist_cross_language_roundtrip(tmp_path):
+    """The native NDList reader/writer is byte-compatible with the Python
+    .params serializer in BOTH directions (reference c_predict_api
+    MXNDListCreate over NDArray::Save files)."""
+    import ctypes
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu._native import lib as _lib_fn
+    lib = _lib_fn()
+    if lib is None:
+        import pytest
+        pytest.skip("native library not built")
+
+    # Python writes -> C reads
+    f = str(tmp_path / "py.params")
+    w = np.arange(12, dtype=np.float32).reshape(3, 4) * 0.5
+    ids = np.array([3, 1, 4], np.int64)
+    mx.nd.save(f, {"arg:w": mx.nd.array(w),
+                   "ids": mx.nd.array(ids, dtype=np.int64)})
+    h = ctypes.c_void_p()
+    count = ctypes.c_size_t()
+    assert lib.MXTNDListCreateFromFile(
+        f.encode(), ctypes.byref(h), ctypes.byref(count)) == 0
+    assert count.value == 2
+    name = ctypes.c_char_p()
+    data = ctypes.c_void_p()
+    shape = ctypes.POINTER(ctypes.c_int64)()
+    ndim = ctypes.c_uint32()
+    flag = ctypes.c_int()
+    got = {}
+    for i in range(2):
+        assert lib.MXTNDListGet(h, i, ctypes.byref(name),
+                                ctypes.byref(data), ctypes.byref(shape),
+                                ctypes.byref(ndim),
+                                ctypes.byref(flag)) == 0
+        shp = tuple(shape[d] for d in range(ndim.value))
+        nbytes = int(np.prod(shp)) * (4 if flag.value == 0 else 8)
+        raw = ctypes.string_at(data, nbytes)
+        got[name.value.decode()] = (shp, flag.value, raw)
+    assert got["arg:w"][0] == (3, 4) and got["arg:w"][1] == 0
+    np.testing.assert_array_equal(
+        np.frombuffer(got["arg:w"][2], np.float32).reshape(3, 4), w)
+    assert got["ids"][1] == 6
+    np.testing.assert_array_equal(
+        np.frombuffer(got["ids"][2], np.int64), ids)
+    assert lib.MXTNDListFree(h) == 0
+
+    # C writes -> Python loads
+    f2 = str(tmp_path / "c.params")
+    names = (ctypes.c_char_p * 1)(b"bias")
+    arr = np.array([1.0, -2.5], np.float32)
+    datas = (ctypes.c_void_p * 1)(arr.ctypes.data)
+    shp_arr = (ctypes.c_int64 * 1)(2)
+    shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shp_arr)
+    ndims = (ctypes.c_uint32 * 1)(1)
+    flags = (ctypes.c_int * 1)(0)
+    assert lib.MXTNDListSave(f2.encode(), 1, names, datas, shapes, ndims,
+                             flags) == 0
+    loaded = mx.nd.load(f2)
+    np.testing.assert_array_equal(loaded["bias"].asnumpy(), arr)
+
+
+def test_ndlist_rejects_corrupt_files(tmp_path):
+    """Crafted corruption must produce clean errors, not out-of-bounds
+    reads: huge name length, huge ndim, negative dims (review r3)."""
+    import ctypes
+    import struct
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu._native import lib as _lib_fn
+    lib = _lib_fn()
+    if lib is None:
+        import pytest
+        pytest.skip("native library not built")
+
+    f = str(tmp_path / "ok.params")
+    mx.nd.save(f, {"w": mx.nd.array(np.ones((2, 2), np.float32))})
+    good = open(f, "rb").read()
+
+    def parse(buf):
+        h = ctypes.c_void_p()
+        count = ctypes.c_size_t()
+        rc = lib.MXTNDListCreate(buf, len(buf), ctypes.byref(h),
+                                 ctypes.byref(count))
+        if rc == 0:
+            lib.MXTNDListFree(h)
+        return rc
+
+    assert parse(good) == 0
+    # name length field is the last 12..4 bytes region: set to huge
+    corrupt = bytearray(good)
+    corrupt[-9:-1] = struct.pack("<Q", 2 ** 63)[0:8]
+    assert parse(bytes(corrupt)) != 0
+    # huge ndim in the record header (offset: 24 list hdr + 4 magic + 4
+    # stype)
+    corrupt = bytearray(good)
+    corrupt[32:36] = struct.pack("<I", 0xFFFFFFF0)
+    assert parse(bytes(corrupt)) != 0
+    # negative dim
+    corrupt = bytearray(good)
+    corrupt[36:44] = struct.pack("<q", -2)
+    assert parse(bytes(corrupt)) != 0
+    # truncated payload
+    assert parse(good[:-6]) != 0
