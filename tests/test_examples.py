@@ -295,3 +295,13 @@ def test_deep_embedded_clustering():
     r = _run("deep-embedded-clustering/dec.py", timeout=900)
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "DEC OK" in r.stdout
+
+
+def test_sparse_embedding_end2end():
+    # shrunk table: below the 500k gate for the wall-clock assert, which
+    # is machine-load sensitive (the O(nnz) guarantee is asserted
+    # deterministically in tests/test_sparse.py)
+    r = _run("sparse/sparse_embedding/train.py", "--rows", "100000",
+             "--steps", "80", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "SPARSE EMBEDDING OK" in r.stdout
