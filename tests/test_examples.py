@@ -305,3 +305,10 @@ def test_sparse_embedding_end2end():
              "--steps", "80", timeout=900)
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "SPARSE EMBEDDING OK" in r.stdout
+
+
+def test_kaggle_pipeline():
+    r = _run("kaggle-ndsb1/train_predict_submit.py", "--num-train", "300",
+             "--num-epochs", "6", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "KAGGLE PIPELINE OK" in r.stdout
