@@ -48,6 +48,7 @@ from . import executor_manager
 from . import gluon
 from . import image
 from . import profiler
+from . import xplane
 from . import visualization
 from .visualization import print_summary
 from . import monitor

@@ -27,8 +27,8 @@ import time
 
 import jax
 
-__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
-           "reset_stats"]
+__all__ = ["set_config", "set_state", "dump", "dumps", "device_dumps",
+           "pause", "resume", "reset_stats"]
 
 _state = {"running": False, "dir": "profile_output", "configured": False}
 _agg = {
@@ -173,6 +173,17 @@ def dumps(reset=False, format="table"):
     if reset:
         reset_stats()
     return "\n".join(out) + "\n"
+
+
+def device_dumps(logdir=None, line_filter=None, by="op", top=40):
+    """Per-op *device-time* table from the captured XPlane trace — the
+    analog of the reference's engine-instrumented aggregate stats
+    (`src/profiler/aggregate_stats.cc`), measured on the device timeline
+    instead of host wall-clock.  Requires a completed trace
+    (``set_state('stop')`` first)."""
+    from . import xplane
+    return xplane.dumps(logdir or _state["dir"], line_filter=line_filter,
+                        by=by, top=top)
 
 
 class Scope:

@@ -92,3 +92,61 @@ def test_op_bench_harness_tiny():
                   if l.startswith('{"metric": "op_us"')]
     assert len(json_lines) >= 10
     assert all(json.loads(l)["us_per_iter"] > 0 for l in json_lines)
+
+
+# ---------------------------------------------------------------------------
+# XPlane device-time attribution (reference engine-instrumented aggregate
+# stats, src/profiler/aggregate_stats.cc + src/engine/threaded_engine.h:80)
+# ---------------------------------------------------------------------------
+
+def test_xplane_device_time_table(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import xplane
+
+    logdir = str(tmp_path / "trace")
+    jax.profiler.start_trace(logdir)
+    f = jax.jit(lambda x, w: jnp.tanh(x @ w) @ w.T)
+    w = jnp.ones((256, 256), jnp.float32)
+    x = jnp.ones((128, 256), jnp.float32)
+    for _ in range(4):
+        x = f(x, w)
+    jax.block_until_ready(x)
+    jax.profiler.stop_trace()
+
+    files = xplane.find_xplane_files(logdir)
+    assert files, "trace capture produced no .xplane.pb"
+
+    # the HLO execution line must show the matmul with nonzero device time
+    table = xplane.op_table(logdir, line_filter="PjRtCpuClient")
+    dots = [k for k in table if "dot" in k or "fusion" in k]
+    assert dots, f"no dot/fusion op in table: {sorted(table)[:20]}"
+    assert all(table[k]["total_ps"] > 0 for k in dots)
+
+    # rendered table is non-empty and carries the share column
+    txt = xplane.dumps(logdir, line_filter="PjRtCpuClient", top=10)
+    assert "Total (ms)" in txt and "%" in txt
+
+    # profiler front door
+    out = profiler.device_dumps(logdir, line_filter="PjRtCpuClient")
+    assert out == txt
+
+
+def test_xplane_cli(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "trace")
+    jax.profiler.start_trace(logdir)
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(jnp.ones((64, 64))))
+    jax.profiler.stop_trace()
+
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.xplane", logdir, "--top", "5",
+         "--json", str(tmp_path / "t.json")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "TOTAL" in out.stdout
+    assert (tmp_path / "t.json").exists()
