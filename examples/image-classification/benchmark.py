@@ -75,6 +75,12 @@ def main():
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"],
                    help="NHWC is the TPU-native conv layout")
+    p.add_argument("--scan-unroll", type=int, default=1,
+                   help="unroll factor for the K-step lax.scan (removes "
+                        "while-loop carry copies; larger compile)")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="capture an XPlane trace of the timed region into "
+                        "DIR; analyze with python -m mxnet_tpu.xplane DIR")
     args = p.parse_args()
 
     import mxnet_tpu as mx
@@ -88,6 +94,7 @@ def main():
 
     mod = build_module(args.model, batch, shape, args.num_classes,
                        args.dtype, ctx, args.lr, layout=args.layout)
+    mod.scan_unroll = args.scan_unroll
 
     rng = np.random.RandomState(0)
     K = args.batches_per_dispatch
@@ -114,6 +121,9 @@ def main():
     print("compiled in %.1fs" % compile_s, flush=True)
 
     calls = max(1, args.num_calls)
+    if args.profile:
+        import jax
+        jax.profiler.start_trace(args.profile)
     t0 = time.time()
     for _ in range(calls):
         if K > 1:
@@ -123,6 +133,10 @@ def main():
     # one readback syncs the chain (steps depend on the params carry)
     last = float(np.asarray(mod.get_outputs()[0].asnumpy()).ravel()[0])
     dt = time.time() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
+        print("trace captured in %s; run: python -m mxnet_tpu.xplane %s "
+              "--line 'XLA Ops'" % (args.profile, args.profile))
     rate = calls * K * batch / dt
     assert np.isfinite(last)
     # MFU: fwd MACs x2 (flops per MAC) x3 (fwd + bwd costs ~2x fwd; the

@@ -78,7 +78,10 @@ def _conv_transpose(attrs, inputs, proto):
         dilate=tuple(attrs.get("dilations", (1,) * n)),
         adj=tuple(attrs.get("output_padding", (0,) * n)),
         pad=begins if symmetric else (0,) * n,
-        num_filter=proto._params[inputs[1].name].shape[1],
+        # ONNX ConvTranspose weight layout is (C, M/group, kH, kW): the
+        # full output channel count is shape[1] * group
+        num_filter=proto._params[inputs[1].name].shape[1]
+        * attrs.get("group", 1),
         num_group=attrs.get("group", 1),
         no_bias=(len(inputs) == 2))
     if not symmetric:
@@ -91,12 +94,28 @@ def _conv_transpose(attrs, inputs, proto):
 
 def _pool(pool_type):
     def impl(attrs, inputs, proto):
+        # Unlike Conv, pooling pads must NOT be lowered to an explicit
+        # zero-Pad node: ONNX MaxPool treats padding as -inf and
+        # AveragePool (count_include_pad=0, the default) excludes padded
+        # cells from the divisor.  Our Pooling op implements exactly those
+        # semantics natively (init=-inf; windowed count), including
+        # asymmetric begin/end pads via ``pad_end``.
         kernel = tuple(attrs["kernel_shape"])
-        data, pad = _maybe_pad(inputs[0], attrs.get("pads"), len(kernel))
+        n = len(kernel)
+        pads = attrs.get("pads")
+        begins = tuple(pads[:n]) if pads else (0,) * n
+        ends = tuple(pads[n:]) if pads else (0,) * n
+        kw = {}
+        if ends != begins:
+            kw["pad_end"] = ends
+        if attrs.get("ceil_mode", 0):
+            kw["pooling_convention"] = "full"
+        if pool_type == "avg":
+            kw["count_include_pad"] = bool(attrs.get("count_include_pad", 0))
         return sym.Pooling(
-            data, kernel=kernel,
-            stride=tuple(attrs.get("strides", (1, 1))),
-            pad=pad, pool_type=pool_type)
+            inputs[0], kernel=kernel,
+            stride=tuple(attrs.get("strides", (1,) * n)),
+            pad=begins, pool_type=pool_type, **kw)
     return impl
 
 

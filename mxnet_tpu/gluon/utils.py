@@ -37,30 +37,47 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
     return slices
 
 
-def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True,
+                   sharded=None):
     """Reference utils.py split_and_load.
 
-    TPU-native divergence for a multi-device ctx list: instead of the
-    reference's per-device slice list (one eager program per device), the
-    batch is placed ONCE, sharded along ``batch_axis`` over a 'dp' mesh of
-    the devices, and returned as a single-element list. A reference-style
-    loop (``for x in split_and_load(...): loss = net(x)``) then runs one
-    SPMD program spanning every device — same math, one dispatch. Pair with
-    parameters initialized with the same ctx list (replicated)."""
+    TPU-native divergence for a multi-device ctx list (``sharded=None``,
+    the default "auto" mode): instead of the reference's per-device slice
+    list (one eager program per device), the batch is placed ONCE, sharded
+    along ``batch_axis`` over a 'dp' mesh of the devices, and returned as a
+    SINGLE-element list. A reference-style loop
+    (``for x in split_and_load(...): loss = net(x)``) then runs one SPMD
+    program spanning every device — same math, one dispatch. Pair with
+    parameters initialized with the same ctx list (replicated).
+
+    Callers that rely on the reference contract
+    ``len(result) == len(ctx_list)`` — zipping slices with contexts,
+    per-slice loss/metric accounting — must pass ``sharded=False`` to get
+    exact per-device slices. ``sharded=True`` demands the mesh-sharded
+    form and raises if the batch/devices cannot support it."""
     if not isinstance(data, NDArray):
         data = array(data, ctx=ctx_list[0])
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
     devices = [c.jax_device() for c in ctx_list]
-    if len(set(devices)) == len(devices) and batch_axis == 0 and \
-            data.shape[0] % len(ctx_list) == 0:
+    shardable = (len(set(devices)) == len(devices) and batch_axis == 0 and
+                 data.shape[0] % len(ctx_list) == 0)
+    if sharded is None:
+        sharded = shardable
+    if sharded:
+        if not shardable:
+            raise ValueError(
+                "sharded=True needs distinct devices and a batch divisible "
+                "by len(ctx_list) along axis 0 (shape %s over %d devices)"
+                % (str(data.shape), len(ctx_list)))
         import jax
         from ..parallel.mesh import batch_sharding
         from ..ndarray.ndarray import _from_data
         return [_from_data(jax.device_put(data._data,
                                           batch_sharding(devices)),
                            ctx_list[0])]
-    # fallback (duplicate devices / uneven batch): reference-style slices
+    # reference-style per-device slices (sharded=False, duplicate devices,
+    # or an uneven batch)
     slices = split_data(data, len(ctx_list), batch_axis, even_split)
     return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
 

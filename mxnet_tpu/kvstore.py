@@ -360,9 +360,17 @@ def create(name="local"):
              "dist_sync_device", "dist_device_sync", "dist")
     if name not in valid:
         raise MXNetError("unknown kvstore type %s" % name)
-    if name == "dist_async" and (
-            "DMLC_PS_ROOT_URI" in os.environ or
-            "MXNET_PS_HOST" in os.environ):
-        return AsyncKVStore()
-    # dist_async without a PS address degrades to BSP sync (documented)
+    if name == "dist_async":
+        if ("DMLC_PS_ROOT_URI" in os.environ or
+                "MXNET_PS_HOST" in os.environ):
+            return AsyncKVStore()
+        # no PS address: degrade to BSP sync — but loudly, because the
+        # user asked for async and is getting a global barrier instead
+        import warnings
+        warnings.warn(
+            "kvstore 'dist_async' requested but no parameter-server "
+            "address is set (DMLC_PS_ROOT_URI / MXNET_PS_HOST): "
+            "degrading to synchronous BSP allreduce. Start a server "
+            "(tools/launch.py or kvstore_server) and set the address "
+            "env vars for true asynchronous training.", stacklevel=2)
     return KVStore(name)

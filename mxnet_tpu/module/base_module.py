@@ -153,7 +153,8 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None, batches_per_dispatch=1):
+            monitor=None, sparse_row_id_fn=None, batches_per_dispatch=1,
+            scan_unroll=None):
         """Reference base_module.py:395 training loop.
 
         TPU extension: ``batches_per_dispatch=K`` groups K batches into ONE
@@ -184,6 +185,9 @@ class BaseModule:
 
         use_scan = batches_per_dispatch > 1 and monitor is None and \
             hasattr(self, "_step_scan")
+        if scan_unroll is not None:
+            # unroll factor for the K-step scan (see Module._step_scan)
+            self.scan_unroll = int(scan_unroll)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

@@ -608,7 +608,14 @@ class Module(BaseModule):
             return None
         if self._fused_plan is None:
             self._fused_plan = self._build_fused_step()
-        plan_key = ("scan", K)
+        # scan unroll factor: unrolling the step body removes the while
+        # loop's per-iteration carry copies (XLA inserts HBM copies for
+        # carried weights whose compute layout differs from the carry
+        # layout) at the price of a K/unroll-times-larger program and
+        # longer compile; set via Module.scan_unroll or
+        # fit(..., scan_unroll=U). 1 = plain while loop.
+        unroll = max(1, int(getattr(self, "scan_unroll", 1) or 1))
+        plan_key = ("scan", K, unroll)
         scan_fn = None if self._scan_plans is None \
             else self._scan_plans.get(plan_key)
         if self._fused_plan is False or self.inputs_need_grad:
@@ -635,7 +642,8 @@ class Module(BaseModule):
                     return (ga, {**aux, **aux_up}, list(new_states), k), \
                         tuple(outs)
                 (ga, aux, sv, _), outs = lax.scan(
-                    body, (grad_args, aux_vals, state_vals, key), stacked)
+                    body, (grad_args, aux_vals, state_vals, key), stacked,
+                    unroll=unroll)
                 return ga, aux, sv, outs
 
             # donate the optimizer states only — matching _step's policy

@@ -19,19 +19,30 @@ Optional bounded staleness (`MXNET_ASYNC_STALENESS=S`): a worker's push
 blocks only while it is more than S pushes ahead of the slowest worker on
 that key (SSP). Unset = unbounded, the reference's pure-async semantics.
 
-Wire protocol (length-prefixed pickle frames over TCP):
-    ("init", key, ndarray)          -> ("ok",)      first writer wins
-    ("push", key, ndarray, rank)    -> ("ok",)      update-on-receive
-    ("pull", key)                   -> ("val", ndarray)
-    ("set_optimizer", bytes)        -> ("ok",)      pickled Optimizer
-    ("num_dead", node_id, timeout)  -> ("n", int)   heartbeat-based
-    ("heartbeat", rank)             -> ("ok",)
-    ("stop",)                       -> ("ok",)
+Wire protocol — NON-EXECUTABLE frames (the reference's ps-lite likewise
+moves raw tensor bytes + a fixed-field header, `van.cc` / `SArray<char>`;
+an executable encoding such as pickle would hand arbitrary code execution
+to anything that can reach the PS port):
+
+    frame     := <Q total_len> <I header_len> header_json raw_bytes
+    header    := {"op": ..., "key": ..., "rank": ..., "dtype": ...,
+                  "shape": [...], ...}   (pure JSON, no code)
+    raw_bytes := the tensor payload, decoded via np.frombuffer against a
+                 whitelisted dtype — zero-copy on receive.
+
+    op=init  key dtype shape + raw      -> ok          first writer wins
+    op=push  key rank dtype shape + raw -> ok          update-on-receive
+    op=pull  key                        -> val dtype shape + raw
+    op=set_optimizer name attrs         -> ok          registry name +
+                                                       scalar attrs only
+    op=heartbeat rank                   -> ok
+    op=num_dead node timeout            -> n
+    op=stop                             -> ok
 """
 from __future__ import annotations
 
+import json
 import os
-import pickle
 import socket
 import socketserver
 import struct
@@ -43,26 +54,107 @@ import numpy as np
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_forever"]
 
 _HDR = struct.Struct("<Q")
+_JLEN = struct.Struct("<I")
+
+# dtypes allowed on the wire: plain numeric buffers only.  np.frombuffer
+# against one of these can never execute anything.
+_WIRE_DTYPES = ("float32", "float64", "float16", "bfloat16", "uint8",
+                "int8", "int32", "int64", "uint64", "uint32", "bool")
 
 
-def _send_frame(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+def _wire_dtype(name):
+    if name not in _WIRE_DTYPES:
+        raise ValueError("dtype %r not allowed on the PS wire" % (name,))
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _send_frame(sock, hdr, payload=b""):
+    """hdr: JSON-serializable dict; payload: raw bytes/ndarray."""
+    if isinstance(payload, np.ndarray):
+        payload = np.ascontiguousarray(payload).tobytes()
+    j = json.dumps(hdr, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_HDR.pack(_JLEN.size + len(j) + len(payload))
+                 + _JLEN.pack(len(j)) + j + payload)
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        buf += chunk
+        got += r
+    # bytearray (not bytes): np.frombuffer over it yields a WRITABLE array,
+    # so pull() results behave like the old API (and no extra copy is paid)
     return buf
 
 
 def _recv_frame(sock):
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    """Returns (header dict, payload ndarray-or-None)."""
+    (total,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if total < _JLEN.size or total > (1 << 40):
+        raise ConnectionError("bad frame length %d" % total)
+    buf = _recv_exact(sock, total)
+    (jlen,) = _JLEN.unpack_from(buf)
+    if jlen > total - _JLEN.size:
+        raise ConnectionError("bad header length %d" % jlen)
+    hdr = json.loads(buf[_JLEN.size:_JLEN.size + jlen].decode("utf-8"))
+    if not isinstance(hdr, dict):
+        raise ConnectionError("bad header")
+    payload = None
+    if "dtype" in hdr:
+        dt = _wire_dtype(hdr["dtype"])
+        shape = tuple(int(d) for d in hdr.get("shape", []))
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        raw = buf[_JLEN.size + jlen:]
+        if len(raw) != n * dt.itemsize:
+            raise ConnectionError("payload size mismatch")
+        payload = np.frombuffer(raw, dtype=dt).reshape(shape)
+    return hdr, payload
+
+
+# scalar types an optimizer may ship over the wire (set_optimizer)
+_SCALARS = (int, float, bool, str, type(None))
+
+
+def optimizer_spec(optimizer):
+    """(registry name, JSON-safe scalar attrs) for an Optimizer instance.
+    Replaces the pickled-object transport: the server reconstructs from
+    the optimizer registry, so only registered optimizers and plain
+    scalar hyperparameters cross the wire."""
+    name = type(optimizer).__name__.lower()
+    attrs = {}
+    dropped = []
+    for k, v in vars(optimizer).items():
+        if isinstance(v, _SCALARS):
+            attrs[k] = v
+        elif not (isinstance(v, (dict, list, tuple, set)) and not v) \
+                and not k.startswith("_"):
+            dropped.append(k)
+    if dropped:
+        import warnings
+        warnings.warn(
+            "dist_async set_optimizer: non-scalar optimizer state %s "
+            "cannot cross the wire and is dropped — the server runs the "
+            "optimizer without it (schedulers/per-param dicts apply "
+            "worker-side only)" % sorted(dropped), stacklevel=3)
+    return name, attrs
+
+
+def optimizer_from_spec(name, attrs):
+    from .. import optimizer as opt
+    if name.lower() not in opt.Optimizer.opt_registry:
+        raise ValueError("unknown optimizer %r" % (name,))
+    o = opt.Optimizer.create_optimizer(name.lower())
+    for k, v in attrs.items():
+        if isinstance(v, _SCALARS):
+            setattr(o, k, v)
+    return o
 
 
 class AsyncPSServer:
@@ -81,46 +173,55 @@ class AsyncPSServer:
         self._cv = threading.Condition(self._global_lock)
 
     # -- handlers --------------------------------------------------------
-    def handle(self, msg):
-        op = msg[0]
+    def handle(self, hdr, payload):
+        """Process one decoded frame; returns (reply header, payload)."""
+        op = hdr.get("op")
+        ok = ({"op": "ok"}, None)
+        if op in ("init", "push") and payload is None:
+            # a dtype-less frame must not poison the store (first-writer-
+            # wins would make an object-dtype key permanent)
+            raise ValueError("%s frame carries no tensor payload" % op)
         if op == "init":
-            _, key, val = msg
+            key = hdr["key"]
             with self._global_lock:
                 if key not in self.store:   # first writer wins (reference
-                    self.store[key] = np.array(val)   # InitImpl)
+                    self.store[key] = np.array(payload)   # InitImpl)
                     self.locks[key] = threading.Lock()
                     self.push_counts[key] = {}
-            return ("ok",)
+            return ok
         if op == "push":
-            _, key, grad, rank = msg
+            key, rank = hdr["key"], hdr.get("rank", 0)
             self._maybe_wait_staleness(key, rank)
             with self.locks[key]:
-                self._apply(key, np.asarray(grad))
+                self._apply(key, np.asarray(payload))
             with self._cv:
                 counts = self.push_counts[key]
                 counts[rank] = counts.get(rank, 0) + 1
                 self._cv.notify_all()
-            return ("ok",)
+            return ok
         if op == "pull":
-            _, key = msg
+            key = hdr["key"]
             with self.locks[key]:
-                return ("val", self.store[key].copy())
+                val = self.store[key].copy()
+            return ({"op": "val", "dtype": str(val.dtype),
+                     "shape": list(val.shape)}, val)
         if op == "set_optimizer":
             from .. import optimizer as opt
-            self.optimizer = pickle.loads(msg[1])
+            self.optimizer = optimizer_from_spec(hdr["name"],
+                                                 hdr.get("attrs", {}))
             self.updater = opt.get_updater(self.optimizer)
-            return ("ok",)
+            return ok
         if op == "heartbeat":
-            self.heartbeats[msg[1]] = time.monotonic()
-            return ("ok",)
+            self.heartbeats[hdr.get("rank", 0)] = time.monotonic()
+            return ok
         if op == "num_dead":
-            _, _node, timeout = msg
             now = time.monotonic()
+            timeout = float(hdr.get("timeout", 60))
             dead = sum(1 for r, t in self.heartbeats.items()
                        if now - t > timeout)
-            return ("n", dead)
+            return ({"op": "n", "n": dead}, None)
         if op == "stop":
-            return ("ok",)
+            return ok
         raise ValueError("unknown op %r" % (op,))
 
     def _maybe_wait_staleness(self, key, rank):
@@ -155,15 +256,16 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         while True:
             try:
-                msg = _recv_frame(self.request)
-            except (ConnectionError, OSError):
+                hdr, payload = _recv_frame(self.request)
+            except (ConnectionError, OSError, ValueError):
                 return
             try:
-                reply = self.server.ps.handle(msg)
+                rhdr, rpayload = self.server.ps.handle(hdr, payload)
             except Exception as e:  # surface server-side errors to worker
-                reply = ("err", repr(e))
-            _send_frame(self.request, reply)
-            if msg[0] == "stop":
+                rhdr, rpayload = {"op": "err", "msg": repr(e)}, None
+            _send_frame(self.request, rhdr,
+                        rpayload if rpayload is not None else b"")
+            if hdr.get("op") == "stop":
                 self.server.shutdown()
                 return
 
@@ -198,35 +300,44 @@ class AsyncPSClient:
         self._sock = socket.create_connection(addr, timeout=120)
         self._lock = threading.Lock()
 
-    def _rpc(self, *msg):
+    def _rpc(self, hdr, payload=b""):
         with self._lock:
-            _send_frame(self._sock, msg)
-            reply = _recv_frame(self._sock)
-        if reply[0] == "err":
-            raise RuntimeError("async PS server error: %s" % reply[1])
-        return reply
+            _send_frame(self._sock, hdr, payload)
+            rhdr, rpayload = _recv_frame(self._sock)
+        if rhdr.get("op") == "err":
+            raise RuntimeError("async PS server error: %s" % rhdr.get("msg"))
+        return rhdr, rpayload
+
+    def _rpc_array(self, op, arr, **extra):
+        arr = np.ascontiguousarray(arr)
+        _wire_dtype(str(arr.dtype))
+        hdr = {"op": op, "dtype": str(arr.dtype), "shape": list(arr.shape)}
+        hdr.update(extra)
+        return self._rpc(hdr, arr)
 
     def init(self, key, value):
-        self._rpc("init", key, np.asarray(value))
+        self._rpc_array("init", np.asarray(value), key=key)
 
     def push(self, key, grad):
-        self._rpc("push", key, np.asarray(grad), self.rank)
+        self._rpc_array("push", np.asarray(grad), key=key, rank=self.rank)
 
     def pull(self, key):
-        return self._rpc("pull", key)[1]
+        return self._rpc({"op": "pull", "key": key})[1]
 
     def set_optimizer(self, optimizer):
-        self._rpc("set_optimizer", pickle.dumps(optimizer))
+        name, attrs = optimizer_spec(optimizer)
+        self._rpc({"op": "set_optimizer", "name": name, "attrs": attrs})
 
     def heartbeat(self):
-        self._rpc("heartbeat", self.rank)
+        self._rpc({"op": "heartbeat", "rank": self.rank})
 
     def num_dead_node(self, node_id=0, timeout=60):
-        return self._rpc("num_dead", node_id, timeout)[1]
+        return self._rpc({"op": "num_dead", "node": node_id,
+                          "timeout": timeout})[0]["n"]
 
     def stop_server(self):
         try:
-            self._rpc("stop")
+            self._rpc({"op": "stop"})
         except (ConnectionError, OSError):
             pass
 

@@ -33,7 +33,6 @@ self-contained stdlib Python — run it directly::
 """
 from __future__ import annotations
 
-import gzip
 import json
 import os
 import re

@@ -44,6 +44,8 @@ static size_t DTypeSize(int flag) {
     case 4: return 4;   // int32
     case 5: return 1;   // int8
     case 6: return 8;   // int64
+    case 12: return 2;  // bfloat16 (this framework's .params extension,
+                        // python/mxnet_tpu/ndarray/utils.py serializer)
     default:
       throw std::runtime_error("unknown dtype flag " +
                                std::to_string(flag));
@@ -181,9 +183,15 @@ int MXTNDListCreateFromFile(const char *path, NDListHandle *out,
   std::FILE *fp = std::fopen(path, "rb");
   if (!fp)
     throw std::runtime_error(std::string("cannot open: ") + path);
-  std::fseek(fp, 0, SEEK_END);
-  long n = std::ftell(fp);
-  std::fseek(fp, 0, SEEK_SET);
+  if (std::fseek(fp, 0, SEEK_END) != 0) {
+    std::fclose(fp);
+    throw std::runtime_error(std::string("cannot seek: ") + path);
+  }
+  int64_t n = static_cast<int64_t>(std::ftell(fp));
+  if (n < 0 || std::fseek(fp, 0, SEEK_SET) != 0) {
+    std::fclose(fp);
+    throw std::runtime_error(std::string("cannot size: ") + path);
+  }
   std::vector<uint8_t> buf(n > 0 ? static_cast<size_t>(n) : 0);
   size_t got = buf.empty() ? 0 : std::fread(buf.data(), 1, buf.size(), fp);
   std::fclose(fp);

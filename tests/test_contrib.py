@@ -232,3 +232,68 @@ def test_onnx_conv_transpose_and_gather_semantics():
     got = g.eval(x=mx.nd.array(np.arange(4, dtype="f")),
                  i=mx.nd.array([-1.0]))[0]
     np.testing.assert_allclose(got.asnumpy(), [3.0])
+
+
+def test_onnx_pooling_pad_semantics():
+    """ONNX pooling padding excludes padded cells: MaxPool pads are -inf,
+    AveragePool (count_include_pad=0, the default) excludes them from the
+    divisor (advisor r3 finding: zero pre-padding silently changed
+    numerics)."""
+    import importlib
+    om = importlib.import_module("mxnet_tpu.contrib.onnx.import_model")
+    import numpy as np
+
+    x = mx.sym.Variable("x")
+
+    class P:
+        _params = {}
+
+    # MaxPool over an all-negative input with asymmetric pads: a zero-pad
+    # implementation would return 0 at the padded border
+    mp = om._CONVERT_MAP["MaxPool"](
+        {"kernel_shape": (2, 2), "strides": (1, 1), "pads": (1, 0, 0, 1)},
+        [x], P)
+    out = mp.eval(x=mx.nd.full((1, 1, 4, 4), -2.0))[0].asnumpy()
+    assert out.shape == (1, 1, 4, 4), out.shape
+    np.testing.assert_allclose(out, -2.0)
+
+    # AveragePool default (count_include_pad=0): ones stay ones at borders
+    ap = om._CONVERT_MAP["AveragePool"](
+        {"kernel_shape": (3, 3), "strides": (1, 1), "pads": (1, 1, 1, 1)},
+        [x], P)
+    out = ap.eval(x=mx.nd.ones((1, 1, 4, 4)))[0].asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(out, 1.0)
+
+    # count_include_pad=1 dilutes the corner by the padded window size
+    ap1 = om._CONVERT_MAP["AveragePool"](
+        {"kernel_shape": (2, 2), "pads": (1, 1, 0, 0),
+         "count_include_pad": 1}, [x], P)
+    out = ap1.eval(x=mx.nd.ones((1, 1, 4, 4)))[0].asnumpy()
+    np.testing.assert_allclose(out[0, 0, 0, 0], 0.25)  # 1 real cell / 4
+    np.testing.assert_allclose(out[0, 0, 1, 1], 1.0)
+
+    # ceil_mode=1 -> 'full' pooling convention output size
+    mpc = om._CONVERT_MAP["MaxPool"](
+        {"kernel_shape": (2, 2), "strides": (2, 2), "ceil_mode": 1},
+        [x], P)
+    out = mpc.eval(x=mx.nd.ones((1, 1, 5, 5)))[0]
+    assert out.shape == (1, 1, 3, 3), out.shape
+
+
+def test_onnx_grouped_conv_transpose_channels():
+    """Grouped ConvTranspose: weight is (C, M/group, kH, kW), so the output
+    channel count is shape[1]*group (advisor r3 finding)."""
+    import importlib
+    om = importlib.import_module("mxnet_tpu.contrib.onnx.import_model")
+
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+
+    class P:
+        _params = {"w": mx.nd.ones((4, 2, 3, 3))}
+
+    ct = om._CONVERT_MAP["ConvTranspose"](
+        {"kernel_shape": (3, 3), "group": 2}, [x, w], P)
+    out = ct.eval(x=mx.nd.ones((1, 4, 5, 5)), w=mx.nd.ones((4, 2, 3, 3)))[0]
+    assert out.shape == (1, 4, 7, 7), out.shape

@@ -203,3 +203,86 @@ def test_module_fit_against_async_ps(tmp_path):
         assert all(a > 0.9 for a in accs), (accs,)
     finally:
         srv.shutdown()
+
+
+def test_wire_format_is_not_executable():
+    """The PS wire is JSON header + raw numpy bytes (reference ps-lite
+    moves raw SArray<char>, not executable objects). pickle must be gone:
+    a malicious frame can, at worst, fail dtype/shape validation — it can
+    never run code (advisor r3 medium finding)."""
+    import io
+    import pickle
+    import socket as socket_mod
+
+    src = open(os.path.join(REPO, "mxnet_tpu", "parallel",
+                            "ps_async.py")).read()
+    assert "import pickle" not in src, "ps_async.py must not use pickle"
+
+    # a pickle bomb sent to the server must be rejected, not executed
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        class Boom:
+            def __reduce__(self):
+                return (print, ("EXECUTED",))
+        evil = pickle.dumps(Boom())
+        s = socket_mod.create_connection((host, port), timeout=10)
+        import struct
+        s.sendall(struct.pack("<Q", len(evil)) + evil)
+        # server drops the connection (bad frame), no crash, still serves
+        s.close()
+        c = ps_async.AsyncPSClient((host, port), rank=0)
+        c.init("x", np.ones(2, np.float32))
+        np.testing.assert_allclose(c.pull("x"), 1.0)
+        c.close()
+    finally:
+        srv.shutdown()
+
+    # set_optimizer ships a registry name + scalar attrs, not an object
+    name, attrs = ps_async.optimizer_spec(
+        __import__("mxnet_tpu").optimizer.SGD(learning_rate=0.25))
+    assert name == "sgd"
+    assert all(isinstance(v, (int, float, bool, str, type(None)))
+               for v in attrs.values())
+    o = ps_async.optimizer_from_spec(name, attrs)
+    assert type(o).__name__ == "SGD"
+    with pytest.raises(ValueError):
+        ps_async.optimizer_from_spec("os.system", {})
+
+
+def test_wire_rejects_exotic_dtype():
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        c = ps_async.AsyncPSClient((host, port), rank=0)
+        with pytest.raises(ValueError, match="not allowed"):
+            c.init("o", np.array([object()], dtype=object))
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_push_pull_throughput_25m_params():
+    """Measured wire throughput for a 25M-param (100 MB fp32) push+pull —
+    the raw-buffer frames must sustain real bandwidth (the old
+    pickled-object path serialized through Python on every hop). Floor is
+    conservative for loaded CI hosts; the printed number is the record."""
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        c = ps_async.AsyncPSClient((host, port), rank=0)
+        w = np.zeros(25_000_000, np.float32)
+        c.init("big", w)
+        g = np.ones_like(w)
+        t0 = time.time()
+        reps = 3
+        for _ in range(reps):
+            c.push("big", g)
+            out = c.pull("big")
+        dt = time.time() - t0
+        mb = reps * 2 * w.nbytes / 1e6
+        rate = mb / dt
+        print("async PS push+pull: %.0f MB in %.2fs = %.0f MB/s"
+              % (mb, dt, rate), flush=True)
+        assert out.shape == w.shape
+        assert rate > 50, "throughput %.0f MB/s is implausibly low" % rate
+        c.close()
+    finally:
+        srv.shutdown()

@@ -100,3 +100,20 @@ def test_save_load_roundtrip_multi_ctx(tmp_path):
     np.testing.assert_allclose(net2.weight.data().asnumpy(),
                                net.weight.data().asnumpy())
     assert len(net2.weight.data()._data.sharding.device_set) == 8
+
+
+def test_split_and_load_reference_contract():
+    """sharded=False restores the reference contract exactly:
+    len(result) == len(ctx_list), slice i on ctx_list[i] (advisor r3)."""
+    ctxs = [mx.cpu(i) for i in range(8)]
+    x = mx.nd.array(np.arange(32 * 4, dtype=np.float32).reshape(32, 4))
+    xs = gluon.utils.split_and_load(x, ctxs, sharded=False)
+    assert len(xs) == 8
+    for i, (xi, ctx) in enumerate(zip(xs, ctxs)):
+        assert xi.shape == (4, 4)
+        assert xi.context == ctx
+        np.testing.assert_array_equal(xi.asnumpy(),
+                                      x.asnumpy()[i * 4:(i + 1) * 4])
+    # sharded=True on an unshardable batch is a loud error, not silence
+    with pytest.raises(ValueError, match="sharded=True"):
+        gluon.utils.split_and_load(mx.nd.ones((12, 4)), ctxs, sharded=True)

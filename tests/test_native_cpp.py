@@ -126,3 +126,56 @@ def test_ndlist_rejects_corrupt_files(tmp_path):
     assert parse(bytes(corrupt)) != 0
     # truncated payload
     assert parse(good[:-6]) != 0
+
+
+def test_ndlist_bf16_roundtrip(tmp_path):
+    """bf16 .params (dtype flag 12, this framework's serializer extension)
+    must round-trip through the native C API (advisor r3: DTypeSize
+    rejected flag 12, so native code couldn't read checkpoints the Python
+    side writes for bf16 models)."""
+    import ctypes
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu._native import lib as _lib_fn
+    lib = _lib_fn()
+    if lib is None:
+        import pytest
+        pytest.skip("native library not built")
+
+    f = str(tmp_path / "bf16.params")
+    w = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3),
+                    dtype="bfloat16")
+    mx.nd.save(f, {"w": w})
+
+    h = ctypes.c_void_p()
+    count = ctypes.c_size_t()
+    assert lib.MXTNDListCreateFromFile(
+        f.encode(), ctypes.byref(h), ctypes.byref(count)) == 0
+    assert count.value == 1
+    name = ctypes.c_char_p()
+    data = ctypes.c_void_p()
+    shape = ctypes.POINTER(ctypes.c_int64)()
+    ndim = ctypes.c_uint32()
+    flag = ctypes.c_int()
+    assert lib.MXTNDListGet(h, 0, ctypes.byref(name), ctypes.byref(data),
+                            ctypes.byref(shape), ctypes.byref(ndim),
+                            ctypes.byref(flag)) == 0
+    assert flag.value == 12
+    raw = ctypes.string_at(data, 2 * 3 * 2)
+    assert lib.MXTNDListFree(h) == 0
+
+    # C writes the same bf16 payload back; Python must load it as bf16
+    f2 = str(tmp_path / "c_bf16.params")
+    names = (ctypes.c_char_p * 1)(b"w")
+    buf = ctypes.create_string_buffer(raw, len(raw))
+    datas = (ctypes.c_void_p * 1)(ctypes.addressof(buf))
+    shp_arr = (ctypes.c_int64 * 2)(2, 3)
+    shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shp_arr)
+    ndims = (ctypes.c_uint32 * 1)(2)
+    flags = (ctypes.c_int * 1)(12)
+    assert lib.MXTNDListSave(f2.encode(), 1, names, datas, shapes, ndims,
+                             flags) == 0
+    loaded = mx.nd.load(f2)["w"]
+    assert str(loaded.dtype) == "bfloat16"
+    np.testing.assert_array_equal(loaded.asnumpy().astype(np.float32),
+                                  np.arange(6, dtype=np.float32).reshape(2, 3))

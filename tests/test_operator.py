@@ -447,3 +447,61 @@ def test_space_to_depth_conv_rewrite_matches_direct():
         for a, b in zip(jax.grad(f_ref, (0, 1))(x, w),
                         jax.grad(f_got, (0, 1))(x, w)):
             assert float(jnp.abs(a - b).max()) < 1e-3
+
+
+def test_batchnorm_backward_oracle():
+    """BN training-mode backward against the analytic batch-norm gradient
+    (reference batch_norm-inl.h BatchNormBackward). The custom-VJP fused
+    backward (ops/nn.py _bn_train_core) must match for both NCHW and NHWC
+    axes and with fix_gamma on/off."""
+    rng = np.random.RandomState(7)
+    N, C, H, W = 4, 5, 3, 6
+    eps = 1e-3
+
+    def oracle(x, g, dy, axis):
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        bs = tuple(-1 if i == axis else 1 for i in range(x.ndim))
+        n = np.prod([x.shape[i] for i in red]).astype(np.float64)
+        m = x.mean(axis=red).reshape(bs)
+        v = ((x - m) ** 2).mean(axis=red).reshape(bs)
+        inv = 1.0 / np.sqrt(v + eps)
+        xhat = (x - m) * inv
+        sdy = dy.sum(axis=red).reshape(bs)
+        sdyx = (dy * xhat).sum(axis=red).reshape(bs)
+        dx = (g.reshape(bs) * inv) * (dy - sdy / n - xhat * sdyx / n)
+        return dx, np.squeeze(sdyx), np.squeeze(sdy)
+
+    for axis, shape in ((1, (N, C, H, W)), (3, (N, H, W, C))):
+        for fix_gamma in (False, True):
+            x_np = (rng.randn(*shape) * 2 + 1).astype(np.float64)
+            g_np = (rng.rand(C) + 0.5).astype(np.float64)
+            b_np = rng.randn(C).astype(np.float64)
+            dy_np = rng.randn(*shape).astype(np.float64)
+
+            x = mx.nd.array(x_np, dtype="float64")
+            g = mx.nd.array(g_np, dtype="float64")
+            b = mx.nd.array(b_np, dtype="float64")
+            mm = mx.nd.zeros((C,), dtype="float64")
+            mv = mx.nd.ones((C,), dtype="float64")
+            for t in (x, g, b):
+                t.attach_grad()
+            with mx.autograd.record():
+                y = mx.nd.BatchNorm(x, g, b, mm, mv, eps=eps, axis=axis,
+                                    fix_gamma=fix_gamma)
+                y = y[0] if isinstance(y, list) else y
+                head = mx.nd.array(dy_np, dtype="float64")
+                loss = (y * head).sum()
+            loss.backward()
+
+            g_eff = np.ones_like(g_np) if fix_gamma else g_np
+            dx_o, dg_o, db_o = oracle(x_np, g_eff, dy_np, axis)
+            # internal statistics accumulate in f32 -> f32-level tolerance
+            np.testing.assert_allclose(x.grad.asnumpy(), dx_o,
+                                       rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(b.grad.asnumpy(), db_o,
+                                       rtol=2e-4, atol=2e-4)
+            if fix_gamma:
+                np.testing.assert_allclose(g.grad.asnumpy(), 0.0, atol=1e-7)
+            else:
+                np.testing.assert_allclose(g.grad.asnumpy(), dg_o,
+                                           rtol=2e-4, atol=2e-4)
