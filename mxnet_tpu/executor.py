@@ -87,7 +87,7 @@ def _build_eval(sym: Symbol, ctx=None):
 
 class Executor:
     def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
-                 shardings=None):
+                 shardings=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
         # name -> jax.sharding.Sharding for SPMD data parallelism (Module
@@ -113,6 +113,19 @@ class Executor:
         self._grad_names = [n for n in self._arg_names
                             if grad_req.get(n, "null") != "null"]
         self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
+        self._grouped = None
+        if group2ctx:
+            from .group_exec import GroupedGraph, groups_in_symbol
+            used = groups_in_symbol(symbol)
+            devs = {group2ctx[g].jax_device() for g in used if g in group2ctx}
+            devs.add(ctx.jax_device())
+            if used and len(devs) > 1:
+                # per-group device placement (reference PlaceDevice pass):
+                # chained per-device programs replace the single jit
+                self._grouped = GroupedGraph(symbol, ctx, group2ctx,
+                                             grad_names=self._grad_names)
+                self._jit_fwd = self._grouped.forward
+                self._jit_fwd_bwd = self._grouped.forward_backward
         self.outputs = []
         self._monitor = None
         self._out_avals = None
@@ -131,6 +144,10 @@ class Executor:
         req = _norm_req(grad_req, arg_names, kwargs)
         if shardings is None and shared_exec is not None:
             shardings = shared_exec._shardings
+        group_place = {}
+        if group2ctx:
+            from .group_exec import var_placements
+            group_place = var_placements(symbol, ctx, group2ctx)
 
         def _make(name, shape, dt):
             # SPMD executors place every buffer with its mesh sharding up
@@ -140,7 +157,8 @@ class Executor:
             if shardings is not None and name in shardings:
                 return _from_data(jnp.zeros(tuple(shape), dt,
                                             device=shardings[name]), ctx)
-            return nd_zeros(shape, ctx=ctx, dtype=dt)
+            # group2ctx: the variable lives on its group's device
+            return nd_zeros(shape, ctx=group_place.get(name, ctx), dtype=dt)
 
         arg_dict = {}
         grad_dict = {}
@@ -175,7 +193,7 @@ class Executor:
                 aux_dict[name] = _make(name, shape,
                                        type_dict.get(name, np.float32))
         return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req,
-                        shardings=shardings)
+                        shardings=shardings, group2ctx=group2ctx)
 
     @staticmethod
     def bind(symbol, ctx, args, args_grad=None, grad_req="write",
@@ -188,7 +206,8 @@ class Executor:
         req = _norm_req(grad_req, arg_names, {})
         if args_grad is None:
             req = {n: "null" for n in arg_names}
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req,
+                        group2ctx=group2ctx)
 
     # -- execution -------------------------------------------------------
     def _gather(self):
@@ -320,6 +339,15 @@ class Executor:
         aux_updates = {}
         if key is None:
             key = self._next_key()
+        if self._grouped is not None:
+            # grouped buffers are committed to different devices; the
+            # eager monitor walk computes on ONE device, so stage
+            # everything to the default device first (debug path — the
+            # reference's monitor likewise serializes execution)
+            dev = self._ctx.jax_device()
+            arg_vals = {n: jax.device_put(v, dev) for n, v in arg_vals.items()}
+            aux_vals = {n: jax.device_put(v, dev) for n, v in aux_vals.items()}
+            key = jax.device_put(key, dev)
         for seq, n in enumerate(nodes):
             if n.is_var():
                 env[id(n)] = [arg_vals.get(n.name, aux_vals.get(n.name))]

@@ -1,0 +1,361 @@
+"""group2ctx model parallelism: per-group device placement.
+
+Reference: ``simple_bind(group2ctx={'dev1': mx.gpu(0), ...})`` maps each
+symbol's ``ctx_group`` attribute (set via ``with mx.AttrScope(
+ctx_group='dev1')``) to a device; the PlaceDevice pass pins ops to their
+group's device and inserts ``_CrossDeviceCopy`` nodes at group edges
+(reference ``python/mxnet/symbol/symbol.py:1280,1326-1327``,
+``src/executor/graph_executor.cc:406``, worked LSTM example under
+``example/model-parallel/lstm``).
+
+TPU-native form: a single XLA program cannot pin individual ops to
+devices, so a grouped bind partitions the topo-sorted graph into maximal
+same-device SEGMENTS, compiles each segment as its own jitted program
+pinned to its group's device, and chains them with explicit
+``jax.device_put`` transfers at the segment edges — the device_put IS the
+reference's _CrossDeviceCopy. Parameters are allocated on the device of
+the segment that first consumes them. Backward runs per-segment
+rematerializing VJPs in reverse order (each backward program recomputes
+its segment's forward internally — XLA fuses it; peak memory stays
+per-device), with cotangents transferred across the same edges.
+
+For SPMD-style model parallelism (sharded weights, one collective
+program) see ``parallel/pipeline.py`` and
+``examples/model-parallel/lstm_sharded.py`` — this module exists for
+reference-pattern parity where distinct layers live on distinct whole
+devices.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import get_op
+
+__all__ = ["GroupedGraph", "groups_in_symbol"]
+
+
+def groups_in_symbol(symbol):
+    """The set of ctx_group attribute values used in a symbol's graph."""
+    out = set()
+    for n in symbol._topo_nodes():
+        g = n.attrs.get("__attrs__", {}).get("ctx_group")
+        if g is not None:
+            out.add(g)
+    return out
+
+
+def var_placements(symbol, ctx, group2ctx):
+    """name -> Context: each variable lives with its first consumer's
+    group (reference PlaceDevice assigns vars to their consumer's device).
+    Empty dict when group2ctx is trivial (single effective device)."""
+    if not group2ctx:
+        return {}
+    used = groups_in_symbol(symbol)
+    if not used:
+        return {}
+    devs = {group2ctx[g].jax_device() for g in used if g in group2ctx}
+    devs.add(ctx.jax_device())
+    if len(devs) <= 1:
+        return {}
+    out = {}
+    for n in symbol._topo_nodes():
+        if n.is_var():
+            continue
+        grp = n.attrs.get("__attrs__", {}).get("ctx_group")
+        c = group2ctx.get(grp, ctx) if grp is not None else ctx
+        for src, _oi in n.inputs:
+            if src.is_var() and src.name not in out:
+                out[src.name] = c
+    return out
+
+
+def _key(seq_of, node, out_idx):
+    return "%d:%d" % (seq_of[id(node)], out_idx)
+
+
+class _Segment:
+    __slots__ = ("nodes", "device", "ctx", "in_keys", "out_keys",
+                 "arg_names", "aux_names", "jit_fwd", "jit_bwd")
+
+    def __init__(self, device, ctx):
+        self.nodes = []          # list of (global_seq, node)
+        self.device = device
+        self.ctx = ctx
+        self.in_keys = []        # env keys produced by earlier segments
+        self.out_keys = []       # env keys consumed later / final outputs
+        self.arg_names = []      # variables read by this segment
+        self.aux_names = []
+        self.jit_fwd = None
+        self.jit_bwd = None
+
+
+class GroupedGraph:
+    """Partitioned multi-device evaluator for one Symbol graph."""
+
+    def __init__(self, symbol, ctx, group2ctx, grad_names=()):
+        self._symbol = symbol
+        nodes = symbol._topo_nodes()
+        symbol._mark_aux()
+        seq_of = {id(n): seq for seq, n in enumerate(nodes)}
+        self._seq_of = seq_of
+        self._out_index = [_key(seq_of, n, i) for n, i in symbol._outputs]
+        default_dev = ctx.jax_device()
+        dev2ctx = {default_dev: ctx}
+        for g, c in (group2ctx or {}).items():
+            dev2ctx[c.jax_device()] = c
+
+        # node -> device (vars resolved below)
+        known = set(group2ctx or ())
+        node_dev = {}
+        for n in nodes:
+            if n.is_var():
+                continue
+            grp = n.attrs.get("__attrs__", {}).get("ctx_group")
+            if grp is not None and grp not in known:
+                raise MXNetError(
+                    "ctx_group '%s' has no entry in group2ctx %r"
+                    % (grp, sorted(known)))
+            dev = group2ctx[grp].jax_device() if grp is not None \
+                else default_dev
+            node_dev[id(n)] = dev
+
+        # maximal same-device runs of the topo order
+        segments = []
+        cur = None
+        for seq, n in enumerate(nodes):
+            if n.is_var():
+                continue
+            dev = node_dev[id(n)]
+            if cur is None or cur.device != dev:
+                cur = _Segment(dev, dev2ctx[dev])
+                segments.append(cur)
+            cur.nodes.append((seq, n))
+
+        # variable home device = device of the first consuming segment
+        var_dev = {}
+        seg_of_node = {}
+        for si, seg in enumerate(segments):
+            for _seq, n in seg.nodes:
+                seg_of_node[id(n)] = si
+                for src, _oi in n.inputs:
+                    if src.is_var() and src.name not in var_dev:
+                        var_dev[src.name] = seg.device
+        self.var_device = var_dev
+        self.var_context = {name: dev2ctx[d] for name, d in var_dev.items()}
+
+        # segment I/O: which env keys cross segment boundaries
+        consumed_later = {}
+        for si, seg in enumerate(segments):
+            ins = set()
+            args = set()
+            auxs = set()
+            local = set()
+            for _seq, n in seg.nodes:
+                for src, oi in n.inputs:
+                    if src.is_var():
+                        (auxs if getattr(src, "_aux_mark", False)
+                         else args).add(src.name)
+                    elif id(src) not in local and \
+                            seg_of_node[id(src)] != si:
+                        k = _key(seq_of, src, oi)
+                        ins.add(k)
+                        consumed_later.setdefault(k, set()).add(si)
+                local.add(id(n))
+            seg.in_keys = sorted(ins)
+            seg.arg_names = sorted(args)
+            seg.aux_names = sorted(auxs)
+        final_keys = set(self._out_index)
+        for si, seg in enumerate(segments):
+            outs = set()
+            for _seq, n in seg.nodes:
+                op = get_op(n.op)
+                params = {k: v for k, v in n.attrs.items()
+                          if k != "__attrs__"}
+                for oi in range(op.n_out(params)):
+                    k = _key(seq_of, n, oi)
+                    if k in consumed_later or k in final_keys:
+                        outs.add(k)
+            seg.out_keys = sorted(outs)
+        self.segments = segments
+        self._grad_names = set(grad_names)
+        self._ctx = ctx
+        self._default_dev = default_dev
+
+        for seg in segments:
+            self._compile_segment(seg)
+
+    # -- per-segment programs -------------------------------------------
+    def _seg_eval(self, seg, env_in, arg_vals, aux_vals, key, is_train):
+        """Pure evaluator for one segment (same semantics as
+        executor._build_eval, restricted to the segment's nodes)."""
+        env = {}
+        aux_updates = {}
+        for seq, n in seg.nodes:
+            op = get_op(n.op)
+            params = {k: v for k, v in n.attrs.items() if k != "__attrs__"}
+            params["_ctx"] = seg.ctx
+            if op.need_train_flag:
+                params["_is_train"] = is_train
+            if op.need_rng:
+                params["_rng_key"] = jax.random.fold_in(key, seq)
+            ins = []
+            for src, oi in n.inputs:
+                if src.is_var():
+                    if src.name in arg_vals:
+                        ins.append(arg_vals[src.name])
+                    elif src.name in aux_vals:
+                        ins.append(aux_vals[src.name])
+                    else:
+                        raise MXNetError("unbound variable %s" % src.name)
+                elif id(src) in env:
+                    ins.append(env[id(src)][oi])
+                else:
+                    ins.append(env_in[_key(self._seq_of, src, oi)])
+            outs = op.fcompute(params, *ins)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            n_out = op.n_out(params)
+            if op.mutate_aux:
+                for ai, new_val in zip(op.mutate_aux, outs[n_out:]):
+                    src, _ = n.inputs[ai]
+                    if src.is_var():
+                        aux_updates[src.name] = new_val
+                outs = outs[:n_out]
+            env[id(n)] = list(outs)
+        env_out = {}
+        for _seq, n in seg.nodes:
+            for oi, v in enumerate(env[id(n)]):
+                k = _key(self._seq_of, n, oi)
+                if k in seg.out_keys:
+                    env_out[k] = v
+        return env_out, aux_updates
+
+    def _compile_segment(self, seg):
+        def fwd(env_in, arg_vals, aux_vals, key, is_train):
+            return self._seg_eval(seg, env_in, arg_vals, aux_vals, key,
+                                  is_train)
+
+        seg.jit_fwd = jax.jit(fwd, static_argnums=(4,))
+
+        def bwd(env_in, diff_args, other_args, aux_vals, key, cts_env):
+            """Rematerializing segment backward: recomputes the segment
+            forward inside this program (the reference keeps per-device
+            forward buffers instead; recompute keeps peak memory
+            per-device and XLA fuses it)."""
+            def f(ei, da):
+                env_out, _aux = self._seg_eval(
+                    seg, ei, {**other_args, **da}, aux_vals, key, True)
+                return env_out
+            _, vjp = jax.vjp(f, env_in, diff_args)
+            return vjp(cts_env)
+
+        seg.jit_bwd = jax.jit(bwd)
+
+    # -- helpers ---------------------------------------------------------
+    def _put(self, val, dev):
+        cur = getattr(val, "device", None)
+        if cur == dev:
+            return val
+        return jax.device_put(val, dev)
+
+    def _seg_inputs(self, seg, env, arg_vals, aux_vals, key):
+        env_in = {k: self._put(env[k], seg.device) for k in seg.in_keys}
+        args = {n: self._put(arg_vals[n], seg.device)
+                for n in seg.arg_names if n in arg_vals}
+        auxs = {n: self._put(aux_vals[n], seg.device)
+                for n in seg.aux_names if n in aux_vals}
+        # vars bound as aux may appear in arg position and vice versa
+        for n in seg.arg_names:
+            if n not in args and n in aux_vals:
+                auxs[n] = self._put(aux_vals[n], seg.device)
+        for n in seg.aux_names:
+            if n not in auxs and n in arg_vals:
+                args[n] = self._put(arg_vals[n], seg.device)
+        k = self._put(key, seg.device)
+        return env_in, args, auxs, k
+
+    # -- executor-facing entry points ------------------------------------
+    def forward(self, arg_vals, aux_vals, key, is_train):
+        """Drop-in for Executor._jit_fwd: chained segment dispatches with
+        device transfers at the edges."""
+        env = {}
+        aux_up_all = {}
+        for seg in self.segments:
+            env_in, args, auxs, k = self._seg_inputs(seg, env, arg_vals,
+                                                     aux_vals, key)
+            env_out, aux_up = seg.jit_fwd(env_in, args, auxs, k,
+                                          bool(is_train))
+            env.update(env_out)
+            aux_up_all.update(aux_up)
+        outs = [self._put(env[k], self._default_dev)
+                for k in self._out_index]
+        return outs, aux_up_all
+
+    def forward_backward(self, grad_args, other_args, aux_vals, key,
+                         head_grads):
+        """Drop-in for Executor._jit_fwd_bwd."""
+        arg_vals = {**other_args, **grad_args}
+        env = {}
+        aux_up_all = {}
+        staged = []
+        for seg in self.segments:
+            env_in, args, auxs, k = self._seg_inputs(seg, env, arg_vals,
+                                                     aux_vals, key)
+            env_out, aux_up = seg.jit_fwd(env_in, args, auxs, k, True)
+            env.update(env_out)
+            aux_up_all.update(aux_up)
+            staged.append((env_in, args, auxs, k, env_out))
+        outs = [self._put(env[k], self._default_dev)
+                for k in self._out_index]
+
+        # output cotangents (same defaults as Executor._fwd_bwd_impl)
+        ct_env = {}
+
+        def _zero_ct(v):
+            if jnp.issubdtype(v.dtype, jnp.inexact):
+                return jnp.zeros_like(v)
+            return np.zeros(v.shape, jax.dtypes.float0)
+
+        for k, o, hg in zip(self._out_index, outs, head_grads):
+            if hg is not None:
+                ct = hg
+            elif jnp.issubdtype(o.dtype, jnp.inexact):
+                ct = jnp.ones_like(o)
+            else:
+                ct = np.zeros(o.shape, jax.dtypes.float0)
+            prev = ct_env.get(k)
+            ct_env[k] = ct if prev is None else prev + ct
+
+        grads = {}
+        for seg, (env_in, args, auxs, k, env_out) in zip(
+                reversed(self.segments), reversed(staged)):
+            cts_env = {}
+            for okey in seg.out_keys:
+                ct = ct_env.get(okey)
+                if ct is None:
+                    ct = _zero_ct(env_out[okey])
+                else:
+                    ct = self._put(ct, seg.device)
+                cts_env[okey] = ct
+            diff_args = {n: v for n, v in args.items()
+                         if n in self._grad_names}
+            oth = {n: v for n, v in args.items()
+                   if n not in self._grad_names}
+            cts_in, cts_args = seg.jit_bwd(env_in, diff_args, oth, auxs,
+                                           k, cts_env)
+            for ikey, ct in cts_in.items():
+                if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
+                    continue
+                prev = ct_env.get(ikey)
+                ct_env[ikey] = ct if prev is None else \
+                    self._put(prev, seg.device) + ct
+            for name, g in cts_args.items():
+                if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                    continue
+                home = self.var_device.get(name, self._default_dev)
+                g = self._put(g, home)
+                grads[name] = g if name not in grads else grads[name] + g
+        return outs, aux_up_all, grads

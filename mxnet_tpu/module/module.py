@@ -268,9 +268,22 @@ class Module(BaseModule):
         self._scan_plans = None
         ctx = self._context[0]
         shardings = self._dp_shardings(shapes)
+        # group2ctxs: reference accepts a dict or a per-dp-replica list of
+        # dicts (executor_group.py); the SPMD dp path replaces per-replica
+        # executors, so one group map applies
+        g2c = self._group2ctxs
+        if isinstance(g2c, (list, tuple)):
+            g2c = g2c[0] if g2c else None
+        if g2c and len(self._context) > 1:
+            from ..base import MXNetError
+            raise MXNetError(
+                "group2ctxs with a multi-device data-parallel context "
+                "list is not supported: use ONE group2ctx dict (model "
+                "parallel) or context=[...] (data parallel), not both")
         self._exec = Executor.simple_bind(self._symbol, ctx, grad_req=req,
                                           shared_exec=shared_exec,
                                           shardings=shardings,
+                                          group2ctx=g2c,
                                           type_dict=type_dict, **shapes)
         from ..symbol.symbol import _graph_infer
         _, self._out_shapes, _ = _graph_infer(self._symbol, shapes)
@@ -533,6 +546,10 @@ class Module(BaseModule):
         or False."""
         if self._kvstore is not None or self._updater is None \
                 or self._monitor is not None:
+            return False
+        if getattr(self._exec, "_grouped", None) is not None:
+            # group2ctx executors run chained per-device programs; the
+            # single-jit fused step cannot span devices
             return False
         fused = opt.FusedApplier.resolve(self._updater)
         if not fused:

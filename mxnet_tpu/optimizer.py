@@ -781,6 +781,21 @@ class FusedApplier:
     def __call__(self, indices, weights, grads):
         import jax
 
+        devs = {getattr(w._data, "device", None) for w in weights}
+        if len(devs) > 1:
+            # group2ctx model parallelism keeps each group's parameters on
+            # its own device: run one fused apply per device group (the
+            # reference's per-array optimizer kernels likewise run on the
+            # owning device)
+            by_dev = {}
+            for i, w, g in zip(indices, weights, grads):
+                by_dev.setdefault(getattr(w._data, "device", None),
+                                  []).append((i, w, g))
+            for items in by_dev.values():
+                self([i for i, _, _ in items], [w for _, w, _ in items],
+                     [g for _, _, g in items])
+            return
+
         lrs, wds, rescale, state_vals = self.prepare(indices, weights)
         op_name, fcompute, static = self.update_op()
 

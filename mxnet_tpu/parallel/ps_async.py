@@ -94,10 +94,16 @@ def _recv_exact(sock, n):
     return buf
 
 
+# largest frame we will buffer (default 4 GiB; MXNET_PS_MAX_FRAME
+# overrides).  The length header is attacker-controlled on an open port:
+# the cap bounds the pre-allocation a single connection can pin.
+_MAX_FRAME = int(os.environ.get("MXNET_PS_MAX_FRAME", str(1 << 32)))
+
+
 def _recv_frame(sock):
     """Returns (header dict, payload ndarray-or-None)."""
     (total,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    if total < _JLEN.size or total > (1 << 40):
+    if total < _JLEN.size or total > _MAX_FRAME:
         raise ConnectionError("bad frame length %d" % total)
     buf = _recv_exact(sock, total)
     (jlen,) = _JLEN.unpack_from(buf)

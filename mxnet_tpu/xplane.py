@@ -279,8 +279,19 @@ def op_table(logdir, line_filter=None, by="op", device_only=True):
     host_fallback = device_only and not any("/device:" in p.name for p in dev)
     table = {}
     for plane in dev if device_only else planes:
+        # hierarchical lines overlap ('XLA Modules' events span their
+        # 'XLA Ops' children): summing every line double-counts device
+        # time.  With no explicit filter, restrict a device plane to its
+        # per-op line when one exists.
+        default_lines = None
+        if not line_filter:
+            ops_lines = [l for l in plane.lines if "XLA Ops" in l.name]
+            if ops_lines:
+                default_lines = {id(l) for l in ops_lines}
         for line in plane.lines:
             if line_filter and line_filter not in line.name:
+                continue
+            if default_lines is not None and id(line) not in default_lines:
                 continue
             # the host 'python' line is a nested call-stack (inclusive,
             # overlapping durations) — useless as an op table

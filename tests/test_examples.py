@@ -63,6 +63,16 @@ def test_model_parallel_lstm():
     assert "sharded LSTM train OK" in r.stdout
 
 
+def test_model_parallel_lstm_group2ctx():
+    """Reference example/model-parallel/lstm pattern: per-layer ctx_group
+    + Module(group2ctxs=...) on distinct virtual devices."""
+    r = _run("model-parallel/lstm_group2ctx.py", "--num-epoch", "2",
+             "--samples", "128", "--seq-len", "6", "--num-hidden", "24")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "next-token accuracy" in r.stdout
+    assert "TFRT_CPU_1" in r.stdout  # layer 1 really lives elsewhere
+
+
 def test_gluon_resnet_tiny():
     r = _run("gluon/train_resnet50.py", "--model", "resnet18_v1",
              "--batch-size", "2", "--image-size", "32",

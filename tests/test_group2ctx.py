@@ -1,0 +1,171 @@
+"""group2ctx model parallelism (reference symbol.py:1280 simple_bind
+group2ctx + PlaceDevice pass graph_executor.cc:406 + the worked
+example/model-parallel/lstm): ops carrying a ctx_group attribute run on
+their group's device, parameters live with their group, transfers happen
+at group edges, and the math matches the single-device run exactly."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.group_exec import GroupedGraph, groups_in_symbol
+
+
+def _grouped_mlp():
+    """Two FC layers pinned to two groups (the reference LSTM example's
+    per-layer `with mx.AttrScope(ctx_group='layer%d')` pattern)."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _devices():
+    import jax
+    return jax.devices("cpu")
+
+
+def test_groups_detected():
+    net = _grouped_mlp()
+    assert groups_in_symbol(net) == {"dev1", "dev2"}
+
+
+def test_simple_bind_places_params_per_group():
+    net = _grouped_mlp()
+    devs = _devices()
+    g2c = {"dev1": mx.cpu(1), "dev2": mx.cpu(2)}
+    exe = mx.executor.Executor.simple_bind(net, mx.cpu(0), group2ctx=g2c,
+                                  data=(8, 10),
+                                  softmax_label=(8,))
+    assert exe._grouped is not None
+    # params live on their group's device
+    assert exe.arg_dict["fc1_weight"]._data.device == devs[1]
+    assert exe.arg_dict["fc1_bias"]._data.device == devs[1]
+    assert exe.arg_dict["fc2_weight"]._data.device == devs[2]
+    # data feeds the first grouped segment
+    assert exe.arg_dict["data"]._data.device == devs[1]
+    # at least two segments on distinct devices
+    seg_devs = [s.device for s in exe._grouped.segments]
+    assert len(set(seg_devs)) >= 2
+
+
+def test_grouped_forward_matches_single_device():
+    net = _grouped_mlp()
+    rng = np.random.RandomState(0)
+    vals = {
+        "data": rng.randn(8, 10).astype(np.float32),
+        "fc1_weight": rng.randn(16, 10).astype(np.float32) * 0.1,
+        "fc1_bias": np.zeros(16, np.float32),
+        "fc2_weight": rng.randn(3, 16).astype(np.float32) * 0.1,
+        "fc2_bias": np.zeros(3, np.float32),
+        "softmax_label": rng.randint(0, 3, 8).astype(np.float32),
+    }
+
+    def run(group2ctx):
+        exe = mx.executor.Executor.simple_bind(
+            net, mx.cpu(0), group2ctx=group2ctx,
+            data=(8, 10), softmax_label=(8,))
+        for k, v in vals.items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=False)
+        return exe.outputs[0].asnumpy()
+
+    ref = run(None)
+    got = run({"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_grouped_backward_matches_single_device():
+    net = _grouped_mlp()
+    rng = np.random.RandomState(1)
+    vals = {
+        "data": rng.randn(8, 10).astype(np.float32),
+        "fc1_weight": rng.randn(16, 10).astype(np.float32) * 0.1,
+        "fc1_bias": np.zeros(16, np.float32),
+        "fc2_weight": rng.randn(3, 16).astype(np.float32) * 0.1,
+        "fc2_bias": np.zeros(3, np.float32),
+        "softmax_label": rng.randint(0, 3, 8).astype(np.float32),
+    }
+
+    def run(group2ctx):
+        exe = mx.executor.Executor.simple_bind(
+            net, mx.cpu(0), group2ctx=group2ctx, grad_req="write",
+            data=(8, 10), softmax_label=(8,))
+        for k, v in vals.items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=True)
+        exe.backward()
+        return {k: g.asnumpy() for k, g in exe.grad_dict.items()
+                if g is not None}
+
+    ref = run(None)
+    got = run({"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    assert set(got) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+    # grads live on the group device of their parameter
+    devs = _devices()
+    exe = mx.executor.Executor.simple_bind(
+        net, mx.cpu(0), group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)},
+        grad_req="write", data=(8, 10), softmax_label=(8,))
+    for k, v in vals.items():
+        exe.arg_dict[k][:] = v
+    exe.forward(is_train=True)
+    exe.backward()
+    assert exe.grad_dict["fc1_weight"]._data.device == devs[1]
+    assert exe.grad_dict["fc2_weight"]._data.device == devs[2]
+
+
+def test_unknown_group_raises():
+    net = _grouped_mlp()
+    with pytest.raises(mx.MXNetError, match="ctx_group 'dev2'"):
+        GroupedGraph(net, mx.cpu(0), {"dev1": mx.cpu(1)})
+
+
+def test_module_group2ctxs_trains_model_parallel_lstm():
+    """The reference model-parallel pattern end-to-end: a stacked LSTM
+    with each layer in its own ctx_group (example/model-parallel/lstm's
+    group structure), trained through Module(group2ctxs=...) on distinct
+    virtual devices — must converge like the ungrouped run."""
+    T, B, D, H = 6, 16, 8, 16
+    rng = np.random.RandomState(3)
+    X = rng.randn(64, T, D).astype(np.float32)
+    y = (X.sum(axis=(1, 2)) > 0).astype(np.float32)
+
+    def build():
+        data = mx.sym.Variable("data")
+        cur = data
+        for layer, grp in ((0, "l0"), (1, "l1")):
+            with mx.AttrScope(ctx_group=grp):
+                cell = mx.rnn.LSTMCell(num_hidden=H,
+                                       prefix="lstm%d_" % layer)
+                outputs, _ = cell.unroll(T, inputs=cur, layout="NTC",
+                                         merge_outputs=True)
+                cur = outputs
+        with mx.AttrScope(ctx_group="l1"):
+            last = mx.sym.slice_axis(cur, axis=1, begin=T - 1, end=T)
+            last = mx.sym.reshape(last, shape=(-1, H))
+            fc = mx.sym.FullyConnected(last, num_hidden=2, name="out_fc")
+        return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    def train(g2c):
+        it = mx.io.NDArrayIter(X, y, batch_size=B,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(build(), context=mx.cpu(0), group2ctxs=g2c)
+        np.random.seed(5)
+        mod.fit(it, num_epoch=6, optimizer="sgd",
+                initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": 0.5})
+        it.reset()
+        m = mx.metric.Accuracy()
+        mod.score(it, m)
+        return m.get()[1]
+
+    acc_grouped = train({"l0": mx.cpu(1), "l1": mx.cpu(2)})
+    assert acc_grouped > 0.9, acc_grouped
+    acc_plain = train(None)
+    # same trajectory modulo float reassociation across devices
+    assert abs(acc_grouped - acc_plain) < 0.1, (acc_grouped, acc_plain)
