@@ -78,6 +78,10 @@ def main():
     p.add_argument("--scan-unroll", type=int, default=1,
                    help="unroll factor for the K-step lax.scan (removes "
                         "while-loop carry copies; larger compile)")
+    p.add_argument("--donate", action="store_true",
+                   help="donate the params carry into the scan program "
+                        "(in-place weight update; benchmark holds no "
+                        "views of old buffers)")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="capture an XPlane trace of the timed region into "
                         "DIR; analyze with python -m mxnet_tpu.xplane DIR")
@@ -95,6 +99,7 @@ def main():
     mod = build_module(args.model, batch, shape, args.num_classes,
                        args.dtype, ctx, args.lr, layout=args.layout)
     mod.scan_unroll = args.scan_unroll
+    mod.scan_donate_params = args.donate
 
     rng = np.random.RandomState(0)
     K = args.batches_per_dispatch

@@ -114,12 +114,13 @@ class Executor:
                             if grad_req.get(n, "null") != "null"]
         self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
         self._grouped = None
+        self._group2ctx = group2ctx
         if group2ctx:
-            from .group_exec import GroupedGraph, groups_in_symbol
-            used = groups_in_symbol(symbol)
-            devs = {group2ctx[g].jax_device() for g in used if g in group2ctx}
-            devs.add(ctx.jax_device())
-            if used and len(devs) > 1:
+            from .group_exec import GroupedGraph, var_placements
+            # var_placements is the single source of truth for "is this
+            # bind effectively multi-device" — simple_bind used the same
+            # call to home the parameters
+            if var_placements(symbol, ctx, group2ctx):
                 # per-group device placement (reference PlaceDevice pass):
                 # chained per-device programs replace the single jit
                 self._grouped = GroupedGraph(symbol, ctx, group2ctx,
@@ -322,7 +323,8 @@ class Executor:
                 shapes[name] = kwargs[name]
         new = Executor.simple_bind(self._symbol, self._ctx,
                                    grad_req=self._grad_req,
-                                   shared_exec=self, **shapes)
+                                   shared_exec=self,
+                                   group2ctx=self._group2ctx, **shapes)
         return new
 
     # -- monitor (reference graph_executor.h:71 monitor callback) --------

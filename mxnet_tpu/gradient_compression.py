@@ -50,6 +50,19 @@ def _dequantize_2bit_impl(packed, threshold, size, dtype):
     return lut[quads]
 
 
+def _dequantize_sum_impl(packed_2d, threshold, size, dtype):
+    """Decode a (P, n_packed) stack of per-worker code arrays and sum the
+    P dequantized gradients — the receive side of the compressed
+    allgather (reference server-side Dequantize + aggregation)."""
+    import jax.numpy as jnp
+
+    quads = jnp.stack([packed_2d & 3, (packed_2d >> 2) & 3,
+                       (packed_2d >> 4) & 3, (packed_2d >> 6) & 3],
+                      axis=2).reshape(packed_2d.shape[0], -1)[:, :size]
+    lut = jnp.asarray([0.0, threshold, -threshold, 0.0], dtype=dtype)
+    return lut[quads].sum(axis=0)
+
+
 class GradientCompression:
     """Stateful compressor: one residual buffer per key (error feedback).
 
@@ -70,6 +83,7 @@ class GradientCompression:
         self._residuals = {}
         self._jit_quantize = None
         self._jit_dequantize = None
+        self._jit_dequantize_sum = None
 
     def get_params(self):
         return {"type": self.type, "threshold": str(self.threshold)}
@@ -94,17 +108,35 @@ class GradientCompression:
         out = self._jit_dequantize(packed, size=size, dtype=dtype)
         return out.reshape(shape)
 
+    def dequantize_sum(self, packed_2d, shape, dtype):
+        """Sum of P dequantized worker gradients from stacked codes."""
+        import jax
+        import numpy as np
+        if self._jit_dequantize_sum is None:
+            self._jit_dequantize_sum = jax.jit(
+                partial(_dequantize_sum_impl, threshold=self.threshold),
+                static_argnames=("size", "dtype"))
+        size = int(np.prod(shape)) if shape else 1
+        out = self._jit_dequantize_sum(packed_2d, size=size, dtype=dtype)
+        return out.reshape(shape)
+
+    def quantize_keyed(self, key, grad_data):
+        """Quantize one gradient against its per-key residual (error
+        feedback); returns the packed uint8 codes that go on the wire."""
+        import jax.numpy as jnp
+        res = self._residuals.get(key)
+        if res is None or res.shape != grad_data.shape:
+            res = jnp.zeros(grad_data.shape, grad_data.dtype)
+        packed, new_res = self.quantize(grad_data, res)
+        self._residuals[key] = new_res
+        return packed
+
     # -- kvstore integration --------------------------------------------
     def compress(self, key, nd_grad):
         """Round-trip one NDArray gradient through the compressed wire."""
-        import jax.numpy as jnp
         from .ndarray import NDArray
 
         g = nd_grad._data
-        res = self._residuals.get(key)
-        if res is None or res.shape != g.shape:
-            res = jnp.zeros(g.shape, g.dtype)
-        packed, new_res = self.quantize(g, res)
-        self._residuals[key] = new_res
+        packed = self.quantize_keyed(key, g)
         deq = self.dequantize(packed, g.shape, g.dtype)
         return NDArray(deq, ctx=nd_grad.context)

@@ -56,7 +56,12 @@ def var_placements(symbol, ctx, group2ctx):
     used = groups_in_symbol(symbol)
     if not used:
         return {}
-    devs = {group2ctx[g].jax_device() for g in used if g in group2ctx}
+    missing = used - set(group2ctx)
+    if missing:
+        raise MXNetError(
+            "ctx_group %r has no entry in group2ctx %r"
+            % (sorted(missing)[0], sorted(group2ctx)))
+    devs = {group2ctx[g].jax_device() for g in used}
     devs.add(ctx.jax_device())
     if len(devs) <= 1:
         return {}

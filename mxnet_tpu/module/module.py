@@ -632,7 +632,8 @@ class Module(BaseModule):
         # longer compile; set via Module.scan_unroll or
         # fit(..., scan_unroll=U). 1 = plain while loop.
         unroll = max(1, int(getattr(self, "scan_unroll", 1) or 1))
-        plan_key = ("scan", K, unroll)
+        plan_key = ("scan", K, unroll,
+                    bool(getattr(self, "scan_donate_params", False)))
         scan_fn = None if self._scan_plans is None \
             else self._scan_plans.get(plan_key)
         if self._fused_plan is False or self.inputs_need_grad:
@@ -667,11 +668,15 @@ class Module(BaseModule):
             # (params are NOT donated: user code may hold raw views of the
             # old weight buffers, and fit() mixes scan and plain steps in
             # one epoch when the batch count isn't a multiple of K, so the
-            # two paths must give the same buffer-lifetime guarantee;
-            # donating params measured ~1% anyway). CPU lacks donation.
-            donate = (8,) if getattr(self._context[0], "device_type",
-                                     "cpu") \
-                not in ("cpu", "cpu_pinned", "cpu_shared") else ()
+            # two paths must give the same buffer-lifetime guarantee).
+            # Module.scan_donate_params=True additionally donates the
+            # params carry — an opt-in for benchmark/throughput loops that
+            # hold no views of the old weight buffers. CPU lacks donation.
+            on_accel = getattr(self._context[0], "device_type", "cpu") \
+                not in ("cpu", "cpu_pinned", "cpu_shared")
+            donate = (8,) if on_accel else ()
+            if on_accel and getattr(self, "scan_donate_params", False):
+                donate = (0, 8)
             scan_fn = jax.jit(scan_step, donate_argnums=donate)
             if self._scan_plans is None:
                 self._scan_plans = {}
@@ -725,8 +730,13 @@ class Module(BaseModule):
                                     key, lrs, wds, rescale, state_vals)
         for name, val in aux.items():
             exec_.aux_dict[name]._data = val
-        for w, name in zip(weights, live_names):
-            w._data = ga[name]
+        # rebind EVERY carried arg (not just the updated weights): with
+        # scan_donate_params the old input buffers are invalid after the
+        # call, including pass-through entries
+        for name, val in ga.items():
+            dst = exec_.arg_dict.get(name)
+            if dst is not None:
+                dst._data = val
         fused.commit_states(indices, sv)
         exec_.outputs = [_from_data(o[-1], exec_._ctx) for o in outs]
         self._params_dirty = True

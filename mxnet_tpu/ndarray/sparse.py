@@ -364,26 +364,68 @@ def add_rows(a, b):
                             shape=a.shape, dtype=out.dtype)
 
 
+def _csr_payload(csr):
+    aux = csr._ensure_aux()
+    vals = jnp.asarray(aux["values"])
+    cols = jnp.asarray(aux["indices"])
+    indptr = np.asarray(aux["indptr"])
+    rows = jnp.asarray(np.repeat(np.arange(csr.shape[0]), np.diff(indptr)))
+    return vals, cols, rows
+
+
+def _csr_dot_impl(vals, cols, rows, shape, rhs_data, transpose_a):
+    """O(nnz * k) CSR(±T) x dense over the compact payload: gather rhs
+    rows, scale by the stored values, segment-sum into output rows —
+    gather + MXU-friendly math, no dense lhs ever materializes."""
+    if not transpose_a:
+        gathered = rhs_data[cols] * vals[:, None].astype(rhs_data.dtype)
+        return jax.ops.segment_sum(gathered, rows, num_segments=shape[0])
+    gathered = rhs_data[rows] * vals[:, None].astype(rhs_data.dtype)
+    return jax.ops.segment_sum(gathered, cols, num_segments=shape[1])
+
+
+class _CSRDot:
+    """Taped compact CSR x dense (reference dot-inl.h FComputeEx forward
+    :1032 AND backward :1074): the gradient to the dense rhs is itself a
+    compact CSR^T x dy product, so training keeps O(nnz) — no dense lhs
+    in forward OR backward. The CSR payload is non-differentiable
+    (reference: sparse lhs gradients unsupported for csr dot)."""
+
+    def __new__(cls, csr, transpose_a):
+        from .. import autograd as _ag
+        payload = _csr_payload(csr)  # computed ONCE, shared by fwd + bwd
+
+        class _Fn(_ag.Function):
+            def forward(self, rhs):
+                out = _csr_dot_impl(*payload, csr.shape, rhs._data,
+                                    transpose_a)
+                return NDArray(out.astype(rhs.dtype), csr.ctx)
+
+            def backward(self, dy):
+                # d(csr @ rhs)/drhs cotangent = csr.T @ dy (and vice
+                # versa) — the SAME compact kernel with transpose flipped
+                g = _csr_dot_impl(*payload, csr.shape, dy._data,
+                                  not transpose_a)
+                return NDArray(g.astype(dy.dtype), csr.ctx)
+
+        return _Fn()
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse-aware dot (reference tensor/dot-inl.h). CSR x dense runs
-    O(nnz * cols) over the compact payload: gather the needed rhs rows and
-    segment-sum into output rows — gather + MXU-friendly math, no dense lhs.
-    Other combinations — and any call under autograd.record(), which needs
-    the tape the op dispatcher builds — use the dense op path."""
+    """Sparse-aware dot (reference tensor/dot-inl.h). CSR x dense (and
+    CSR.T x dense) runs O(nnz * cols) over the compact payload, including
+    under ``autograd.record()``: the taped form carries a custom VJP whose
+    backward is the transposed compact product, so a sparse linear model
+    trains without ever densifying the lhs. Other combinations use the
+    dense op path."""
     from .. import autograd as _ag
     if isinstance(lhs, CSRNDArray) and lhs.has_compact() and \
-            not _ag.is_recording() and \
-            not transpose_a and not transpose_b and \
+            not transpose_b and \
             isinstance(rhs, NDArray) and rhs.ndim == 2:
-        aux = lhs._ensure_aux()
-        vals = jnp.asarray(aux["values"])
-        cols = jnp.asarray(aux["indices"])
-        indptr = np.asarray(aux["indptr"])
-        rows = jnp.asarray(np.repeat(np.arange(lhs.shape[0]),
-                                     np.diff(indptr)))
-        gathered = rhs._data[cols] * vals[:, None].astype(rhs.dtype)
-        out = jax.ops.segment_sum(gathered, rows,
-                                  num_segments=lhs.shape[0])
+        if _ag.is_recording():
+            return _CSRDot(lhs, transpose_a)(rhs)
+        out = _csr_dot_impl(*_csr_payload(lhs), lhs.shape, rhs._data,
+                            transpose_a)
         return NDArray(out.astype(rhs.dtype), lhs.ctx)
     from ..ops.invoke import invoke
     return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a,

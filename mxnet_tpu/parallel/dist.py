@@ -120,6 +120,37 @@ def allreduce_nds(nds):
     return results
 
 
+def allgather_arrays(arrs):
+    """All-gather a LIST of per-process jnp arrays in ONE dispatch: each
+    process contributes its local array; every process receives the
+    stacked ``(P, ...)`` result. This is the compressed-gradient wire
+    (reference kvstore_dist.h:379: quantized codes are what crosses the
+    network, 2-bit codes = 1/16 the dense f32 bytes per direction) —
+    ONLY the given arrays' bytes ride the collective."""
+    if jax.process_count() == 1 or not arrs:
+        return [a[None] for a in arrs]
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _proc_mesh()
+    nproc = jax.process_count()
+    my_dev = mesh.devices.flat[jax.process_index()]
+    in_shard = NamedSharding(mesh, P("p"))
+    out_shard = NamedSharding(mesh, P())
+    globals_in = []
+    for a in arrs:
+        local = jax.device_put(jnp.asarray(a)[None], my_dev)
+        g = jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(a.shape), in_shard, [local])
+        globals_in.append(g)
+    key = ("ag",) + tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+    fn = _AR_JIT.get(key)
+    if fn is None:
+        fn = jax.jit(lambda *gs: gs, out_shardings=out_shard)
+        _AR_JIT[key] = fn
+    outs = fn(*globals_in)
+    return [o.addressable_data(0) for o in outs]
+
+
 def allreduce_nd(nd):
     """Sum an NDArray across processes (single-key allreduce_nds)."""
     if jax.process_count() == 1:

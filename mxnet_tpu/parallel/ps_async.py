@@ -104,7 +104,9 @@ def _recv_frame(sock):
     """Returns (header dict, payload ndarray-or-None)."""
     (total,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if total < _JLEN.size or total > _MAX_FRAME:
-        raise ConnectionError("bad frame length %d" % total)
+        raise ConnectionError(
+            "bad frame length %d (max %d; raise MXNET_PS_MAX_FRAME for "
+            "larger single-tensor pushes)" % (total, _MAX_FRAME))
     buf = _recv_exact(sock, total)
     (jlen,) = _JLEN.unpack_from(buf)
     if jlen > total - _JLEN.size:

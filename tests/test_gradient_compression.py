@@ -77,3 +77,80 @@ def test_kvstore_compression_params_recorded():
     assert kv._gc.threshold == 2.0
     with pytest.raises(ValueError):
         kv.set_gradient_compression({"type": "1bit"})
+
+
+def test_compressed_wire_bytes_two_process(tmp_path):
+    """2-process dist_sync with 2-bit compression: only the packed uint8
+    codes cross the collective — transferred bytes ~= dense/16 (reference
+    kvstore_dist.h:379 Quantize-before-ZPush) — and training semantics
+    survive (error feedback keeps the sum drifting toward the true
+    gradient)."""
+    import os
+    import re
+    import subprocess
+    import sys
+    TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os\n"
+        "os.environ.setdefault('PALLAS_AXON_POOL_IPS', '')\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu.parallel import dist\n"
+        "dist.init()\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "kv.set_gradient_compression({'type': '2bit', 'threshold': 0.5})\n"
+        "rank = kv.rank\n"
+        "kv.init('w', mx.nd.zeros((64, 64)))\n"
+        "g = mx.nd.ones((64, 64)) * (0.6 if rank == 0 else -0.6)\n"
+        "kv.push('w', g)\n"
+        "out = mx.nd.zeros((64, 64))\n"
+        "kv.pull('w', out=out)\n"
+        "# +0.5 (rank0, code 01) + -0.5 (rank1, code 10) = 0.0 stored\n"
+        "np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-6)\n"
+        "wire = kv._last_wire_bytes\n"
+        "dense = kv._last_dense_bytes\n"
+        "assert wire * 15 <= dense, (wire, dense)\n"
+        "print('WIRE %d DENSE %d RATIO %.1f OK' % (wire, dense,\n"
+        "      dense / wire))\n"
+        "# error feedback: residual 0.1 accumulates across pushes\n"
+        "for _ in range(4):\n"
+        "    kv.push('w', mx.nd.ones((64, 64)) * 0.3)\n"
+        "kv.pull('w', out=out)\n"
+        "assert abs(out.asnumpy().mean()) > 0.1\n"
+        "print('GC DIST', rank, 'OK')\n")
+    env = dict(os.environ, PYTHONPATH=os.path.join(TOOLS, os.pardir))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", "2",
+         "--port", "9447", "--", sys.executable, str(worker)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert r.stdout.count("OK") == 4
+    m = re.search(r"RATIO ([\d.]+)", r.stdout)
+    assert float(m.group(1)) >= 15.0
+
+
+def test_training_accuracy_with_compression():
+    """Accuracy smoke (reference docs/faq/gradient_compression.md): a
+    separable problem still trains to high accuracy through the
+    quantized gradient path with a sane threshold."""
+    rng = np.random.RandomState(0)
+    protos = rng.rand(4, 16).astype("f") * 2
+    y = rng.randint(0, 4, 600)
+    X = protos[y] + rng.randn(600, 16).astype("f") * 0.1
+    it = mx.io.NDArrayIter(X, y.astype("f"), 50, shuffle=True)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.05})
+    mod = mx.mod.Module(net)
+    mod.fit(it, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5}, num_epoch=12,
+            kvstore=kv)
+    acc = dict(mod.score(mx.io.NDArrayIter(X, y.astype("f"), 50),
+                         "acc"))["accuracy"]
+    assert acc > 0.9, acc

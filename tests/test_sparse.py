@@ -268,3 +268,35 @@ def test_sparse_dot_differentiable_under_record():
     # d(sum(A@R))/dR = A^T @ ones
     want = DENSE.T.dot(np.ones((4, 5), "f"))
     np.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+def test_sparse_dot_under_record_never_densifies(monkeypatch):
+    """Training-path economics (reference dot-inl.h FComputeEx fwd :1032 +
+    bwd :1074): under record, forward AND backward must run over the
+    compact payload — densifying the CSR lhs anywhere raises here."""
+    csr = sparse.csr_matrix(DENSE)
+
+    def boom(self):
+        raise AssertionError("CSR lhs was densified")
+
+    monkeypatch.setattr(sparse.CSRNDArray, "_materialize", boom)
+
+    rhs = mx.nd.array(np.random.RandomState(1).rand(3, 5).astype("f"))
+    rhs.attach_grad()
+    with mx.autograd.record():
+        out = sparse.dot(csr, rhs)
+        loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(rhs.grad.asnumpy(),
+                               DENSE.T.dot(np.ones((4, 5), "f")), rtol=1e-5)
+
+    # csr.T x dense: same economics, transposed
+    rhs_t = mx.nd.array(np.random.RandomState(2).rand(4, 5).astype("f"))
+    rhs_t.attach_grad()
+    with mx.autograd.record():
+        out = sparse.dot(csr, rhs_t, transpose_a=True)
+        loss = (out * out).sum()
+    loss.backward()
+    out_np = DENSE.T.dot(rhs_t.asnumpy())
+    want = DENSE.dot(2 * out_np)
+    np.testing.assert_allclose(rhs_t.grad.asnumpy(), want, rtol=1e-5)

@@ -241,3 +241,25 @@ def test_launch_dist_gluon_trainer_local_update(tmp_path):
     assert r.stdout.count("OK") == 2
     digests = re.findall(r"DIGEST ([0-9.]+)", r.stdout)
     assert len(digests) == 2 and digests[0] == digests[1], digests
+
+
+def test_bench_all_emits_json_records(tmp_path):
+    """tools/bench_all.py records a north-star config as a bench.py-style
+    JSON line + combined file (VERDICT r3 #7: per-round regression
+    record for the BASELINE.md configs)."""
+    import json
+    out = tmp_path / "rec.json"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                      "bench_all.py"),
+         "--only", "sparse_fm", "--out", str(out)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""})
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "sparse_fm_samples_per_sec"
+    assert rec["value"] and rec["value"] > 0
+    saved = json.loads(out.read_text())
+    assert saved[0]["metric"] == rec["metric"]
