@@ -40,6 +40,78 @@ from .symbol.symbol import Symbol, _graph_infer
 __all__ = ["Executor"]
 
 
+def _truthy(v):
+    return v in (True, 1) or str(v).lower() in ("true", "1")
+
+
+def _plan_conv_bias_bn_fold(sym: Symbol, nodes):
+    """Graph-optimization pass: elide a conv bias that feeds straight into a
+    BatchNorm over the same channel axis.
+
+    BN's mean subtraction cancels any per-channel offset exactly, so the
+    bias contributes NOTHING to the loss (its gradient is identically zero
+    in real arithmetic) — yet computing that zero costs a full
+    reduce over the (N, spatial..., C) output gradient per conv (~13% of
+    ResNet-50 v1 device step time on TPU, where the Gluon zoo's
+    BottleneckV1 1x1 convs carry biases, mirroring the reference
+    gluon/model_zoo/vision/resnet.py:107,113). The rewrite drops the bias
+    from the conv and hands it to the BN, which folds it into the running
+    -mean aux update (train: running_mean tracks mean(x)+b; eval: normalize
+    with running_mean-b) — bit-parity with the unfused graph up to bf16
+    rounding of the elided add.
+
+    Pure eval-time plan: returns {id(node): action} consulted by eval_fn;
+    the shared Symbol is never mutated (other binds see the original
+    graph). Skip with MXNET_FOLD_CONV_BIAS_BN=0. Skips BNs with
+    use_global_stats (there the bias has a real gradient through the fixed
+    -stats affine path)."""
+    import os
+    if os.environ.get("MXNET_FOLD_CONV_BIAS_BN", "1") == "0":
+        return {}
+    consumers = {}
+    for n in nodes:
+        for src, oi in n.inputs:
+            consumers.setdefault((id(src), oi), []).append(n)
+    for nd_, i in sym._outputs:
+        consumers.setdefault((id(nd_), i), []).append(None)
+    folds = {}
+    for n in nodes:
+        if n.op not in ("BatchNorm", "BatchNorm_v1") or not n.inputs:
+            continue
+        if _truthy(n.attrs.get("use_global_stats", False)):
+            continue
+        conv, oi = n.inputs[0]
+        if conv.is_var() or conv.op != "Convolution" or oi != 0:
+            continue
+        if id(conv) in folds:
+            continue
+        attrs = conv.attrs
+        if _truthy(attrs.get("no_bias", False)) or len(conv.inputs) < 3:
+            continue
+        kernel = tuple(attrs.get("kernel") or ())
+        if not kernel:
+            continue
+        rank = len(kernel) + 2
+        spec = "DHW"[3 - len(kernel):]
+        layout = attrs.get("layout") or ("NC" + spec)
+        if layout in (None, "None"):
+            layout = "NC" + spec
+        if layout == "NC" + spec:
+            ch_axis = 1
+        elif layout == "N" + spec + "C":
+            ch_axis = rank - 1
+        else:
+            continue
+        if int(n.attrs.get("axis", 1)) % rank != ch_axis:
+            continue
+        if len(consumers.get((id(conv), 0), [])) != 1:
+            continue
+        bias_src, bias_oi = conv.inputs[2]
+        folds[id(conv)] = ("drop_bias",)
+        folds[id(n)] = ("fold_bias", bias_src, bias_oi)
+    return folds
+
+
 def _build_eval(sym: Symbol, ctx=None):
     """Build eval_fn(arg_vals, aux_vals, key, is_train) -> (outs, aux_updates).
 
@@ -48,6 +120,7 @@ def _build_eval(sym: Symbol, ctx=None):
     nodes = sym._topo_nodes()
     sym._mark_aux()
     out_index = [(id(n), i) for n, i in sym._outputs]
+    folds = _plan_conv_bias_bn_fold(sym, nodes)
 
     def eval_fn(arg_vals, aux_vals, key, is_train):
         env = {}
@@ -68,6 +141,12 @@ def _build_eval(sym: Symbol, ctx=None):
                 params["_is_train"] = is_train
             if op.need_rng:
                 params["_rng_key"] = jax.random.fold_in(key, seq)
+            fold = folds.get(id(n))
+            if fold is not None:
+                if fold[0] == "drop_bias":
+                    params["no_bias"] = True
+                else:
+                    params["_fold_bias"] = env[id(fold[1])][fold[2]]
             ins = [env[id(src)][oi] for src, oi in n.inputs]
             outs = op.fcompute(params, *ins)
             if not isinstance(outs, (tuple, list)):

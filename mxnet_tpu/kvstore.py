@@ -250,10 +250,10 @@ class KVStore:
         from .ndarray import sparse as _sp
         from .parallel import dist
 
-        dense_ix = [i for i, m in enumerate(merged_list)
-                    if not isinstance(m, _sp.RowSparseNDArray)]
-        sparse_ix = [i for i in range(len(merged_list))
-                     if i not in dense_ix]
+        dense_ix, sparse_ix = [], []
+        for i, m in enumerate(merged_list):
+            (sparse_ix if isinstance(m, _sp.RowSparseNDArray)
+             else dense_ix).append(i)
         out = list(merged_list)
         if sparse_ix:
             reduced = dist.allreduce_nds([merged_list[i] for i in sparse_ix])

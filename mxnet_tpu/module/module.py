@@ -618,11 +618,21 @@ class Module(BaseModule):
         Returns the per-step stacked outputs (list over module outputs,
         each with leading axis K) for metric updates; grad_dict is NOT
         rebound (use plain `_step` when per-batch gradients are needed).
+
+        ``data_batches`` may also be a prestacked dict from
+        :meth:`stack_batches` — the staging (stack + device placement) then
+        happened ahead of time, off the step's critical path (a data
+        pipeline can stage superbatch N+1 while N trains; over a
+        high-latency PJRT link the staging round-trips otherwise serialize
+        with the dispatch).
         """
-        K = len(data_batches)
-        if K == 1:
-            self._step(data_batches[0])
-            return None
+        if isinstance(data_batches, dict):
+            K = next(iter(data_batches.values())).shape[0]
+        else:
+            K = len(data_batches)
+            if K == 1:
+                self._step(data_batches[0])
+                return None
         if self._fused_plan is None:
             self._fused_plan = self._build_fused_step()
         # scan unroll factor: unrolling the step body removes the while
@@ -682,11 +692,43 @@ class Module(BaseModule):
                 self._scan_plans = {}
             self._scan_plans[plan_key] = scan_fn
 
-        # stack K batches -> one (K, batch, ...) input per arg. Device-
-        # resident batches stack on-device (no host round trip — benchmark
-        # batches live on the chip); host batches stack in numpy and move
-        # in ONE transfer.
+        placed = data_batches if isinstance(data_batches, dict) \
+            else self.stack_batches(data_batches)
+
+        arg_vals, aux_vals = exec_._gather()
+        grad_args = {n: arg_vals[n] for n in exec_._grad_names}
+        consts = {n: v for n, v in arg_vals.items()
+                  if n not in exec_._grad_names and n not in placed}
+        weights = [exec_.arg_dict[n] for n in live_names]
+        lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+        key = exec_._next_key()
+        ga, aux, sv, outs = scan_fn(grad_args, consts, placed, aux_vals,
+                                    key, lrs, wds, rescale, state_vals)
+        for name, val in aux.items():
+            exec_.aux_dict[name]._data = val
+        # rebind EVERY carried arg (not just the updated weights): with
+        # scan_donate_params the old input buffers are invalid after the
+        # call, including pass-through entries
+        for name, val in ga.items():
+            dst = exec_.arg_dict.get(name)
+            if dst is not None:
+                dst._data = val
+        fused.commit_states(indices, sv)
+        exec_.outputs = [_from_data(o[-1], exec_._ctx) for o in outs]
+        self._params_dirty = True
+        return [_from_data(o, exec_._ctx) for o in outs]
+
+    def stack_batches(self, data_batches):
+        """Stage K DataBatches as ONE stacked (K, batch, ...) device array
+        per input, placed/sharded for :meth:`_step_scan`.
+
+        Device-resident batches stack on-device (no host round trip); host
+        batches stack in numpy and move in one transfer. Calling this ahead
+        of the step keeps input staging off the dispatch critical path."""
+        import numpy as _np
+        import jax
         import jax.numpy as jnp
+        exec_ = self._exec
 
         def _stack(vals):
             if all(isinstance(v, NDArray) for v in vals):
@@ -718,29 +760,7 @@ class Module(BaseModule):
                 cur = None if isinstance(arr, _np.ndarray) else device_of(arr)
                 placed[name] = arr if cur == dev \
                     else jax.device_put(arr, dev)
-
-        arg_vals, aux_vals = exec_._gather()
-        grad_args = {n: arg_vals[n] for n in exec_._grad_names}
-        consts = {n: v for n, v in arg_vals.items()
-                  if n not in exec_._grad_names and n not in placed}
-        weights = [exec_.arg_dict[n] for n in live_names]
-        lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
-        key = exec_._next_key()
-        ga, aux, sv, outs = scan_fn(grad_args, consts, placed, aux_vals,
-                                    key, lrs, wds, rescale, state_vals)
-        for name, val in aux.items():
-            exec_.aux_dict[name]._data = val
-        # rebind EVERY carried arg (not just the updated weights): with
-        # scan_donate_params the old input buffers are invalid after the
-        # call, including pass-through entries
-        for name, val in ga.items():
-            dst = exec_.arg_dict.get(name)
-            if dst is not None:
-                dst._data = val
-        fused.commit_states(indices, sv)
-        exec_.outputs = [_from_data(o[-1], exec_._ctx) for o in outs]
-        self._params_dirty = True
-        return [_from_data(o, exec_._ctx) for o in outs]
+        return placed
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -141,6 +141,128 @@ def _space_to_depth_conv(data, weight, pad):
     return out[:, :, :out_h, :out_w]
 
 
+def _conv1x1_dot_wanted(stride):
+    """MXNET_CONV1X1_DOT: default '0' — 1x1 convs stay convolutions.
+
+    Measured on ResNet-50 bf16 bs128 NHWC, rewriting 1x1 convs as dots
+    LOSES ~4% step time ('all') / ~3% ('strided'): XLA's conv emitters
+    win on BN/relu epilogue fusion, and even the lhs-dilated strided
+    dgrad beats the pad+matmul form once fusion is accounted for. The
+    modes stay env-gated for models where pointwise convs dominate
+    differently: 'strided' rewrites only stride>1 1x1 convs, 'all'/'1'
+    rewrites every 1x1."""
+    mode = os.environ.get("MXNET_CONV1X1_DOT", "0")
+    if mode == "0":
+        return False
+    if mode == "all" or mode == "1":
+        return True
+    return max(stride) > 1
+
+
+def _conv1x1_as_dot(data, weight, stride, caxis):
+    """1x1 conv as strided-slice + dot_general.
+
+    TPU-first rewrite: 36 of ResNet-50's 53 convs are 1x1; lowering them as
+    matmuls instead of conv_general_dilated means their autodiff transposes
+    are matmuls too — the input gradient of a STRIDED 1x1 conv becomes
+    pad(dy @ W^T) (a bandwidth op) instead of an lhs-dilated convolution
+    (which computes on a grid of injected zeros), and the weight gradient
+    becomes a plain f32-accumulated MXU matmul. The slice's transpose is an
+    interior pad; XLA derives both for free.
+    """
+    nd = data.ndim - 2
+    w2 = weight.reshape(weight.shape[0], -1)    # (O, C) for OI1..1 / O1..1I
+    if caxis == 1:
+        x = data[(slice(None), slice(None))
+                 + tuple(slice(None, None, s) for s in stride)]
+        out = lax.dot_general(x, w2, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        # (N, *spatial, O) -> (N, O, *spatial)
+        out = out.transpose((0, nd + 1) + tuple(range(1, nd + 1)))
+    else:
+        x = data[(slice(None),)
+                 + tuple(slice(None, None, s) for s in stride)]
+        out = lax.dot_general(x, w2, (((data.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out.astype(data.dtype)
+
+
+@_functools.lru_cache(maxsize=None)
+def _conv1x1_strided_fn(stride, dspec, wspec, caxis, dshape):
+    """Strided 1x1 conv with a hand-written transpose (jax.custom_vjp).
+
+    Forward stays `lax.conv_general_dilated` — XLA's conv emitters fuse the
+    BN/relu epilogues better than a dot (measured, see _conv1x1_dot_wanted).
+    The AUTODIFF transpose of a strided conv, however, is an lhs-dilated
+    convolution that computes over a grid of interior zeros — on ResNet-50
+    bf16 those stage-entry dgrads run at 6-12 TF/s vs ~130 for forward
+    convs. Here dgrad = interior-pad(dy @ W^T) (one MXU matmul + a
+    bandwidth pad) and wgrad = dy^T @ x_strided (one f32-accumulated
+    matmul); the strided input slice is the only residual kept.
+
+    Default OFF (MXNET_CONV1X1_BWD=1 to enable): on ResNet-50 bf16 bs128
+    NHWC the matmul form measured ~3% SLOWER end-to-end — breaking the
+    conv up denies XLA the dgrad-conv + BN-backward-reduce output fusion,
+    and the materialized pad costs more than the dilated emitter saves.
+    Kept for architectures where strided pointwise convs dominate.
+
+    Cached per (stride, layout, input shape): jit retraces per shape
+    signature anyway, so the cache is bounded by the model's conv configs.
+    """
+    nd = len(stride)
+
+    def conv_fwd(data, weight):
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        (dspec, wspec, dspec))
+        return lax.conv_general_dilated(
+            data, weight, window_strides=stride,
+            padding=[(0, 0)] * nd, dimension_numbers=dn)
+
+    f = jax.custom_vjp(conv_fwd)
+
+    def fwd_rule(data, weight):
+        if caxis == 1:
+            xs = data[(slice(None), slice(None))
+                      + tuple(slice(None, None, s) for s in stride)]
+        else:
+            xs = data[(slice(None),)
+                      + tuple(slice(None, None, s) for s in stride)]
+        return conv_fwd(data, weight), (xs, weight)
+
+    def bwd_rule(res, dy):
+        xs, weight = res
+        w2 = weight.reshape(weight.shape[0], -1)        # (O, C)
+        if caxis == 1:
+            sp = tuple(range(2, 2 + nd))
+            dz = lax.dot_general(dy, w2, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            # (N, *sp_out, C) -> (N, C, *sp_out)
+            dz = dz.transpose((0, nd + 1) + tuple(range(1, nd + 1)))
+            dw = lax.dot_general(
+                dy, xs, (((0,) + sp, (0,) + sp), ((), ())),
+                preferred_element_type=jnp.float32)     # (O, C)
+            sp_off = 2
+        else:
+            sp = tuple(range(1, 1 + nd))
+            dz = lax.dot_general(dy, w2, (((nd + 1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            dw = lax.dot_general(
+                dy, xs, (((0,) + sp, (0,) + sp), ((), ())),
+                preferred_element_type=jnp.float32)     # (O, C)
+            sp_off = 1
+        dz = dz.astype(xs.dtype)
+        pads = [(0, 0, 0)] * dz.ndim
+        for ax, s in enumerate(stride):
+            full = dshape[sp_off + ax]
+            cur = dz.shape[sp_off + ax]
+            pads[sp_off + ax] = (0, full - ((cur - 1) * s + 1), s - 1)
+        dx = lax.pad(dz, jnp.zeros((), dz.dtype), pads)
+        return dx, dw.reshape(weight.shape).astype(weight.dtype)
+
+    f.defvjp(fwd_rule, bwd_rule)
+    return f
+
+
 @register("Convolution")
 def _convolution(params, data, weight, *bias):
     kernel = tuple(params["kernel"])
@@ -153,6 +275,15 @@ def _convolution(params, data, weight, *bias):
     if _s2d_eligible(params, data, weight, kernel, stride, dilate, groups,
                      caxis):
         out = _space_to_depth_conv(data, weight, pad)
+    elif (set(kernel) == {1} and set(pad) == {0} and set(dilate) == {1}
+          and groups == 1
+          and _conv1x1_dot_wanted(stride)):
+        out = _conv1x1_as_dot(data, weight, stride, caxis)
+    elif (set(kernel) == {1} and set(pad) == {0} and set(dilate) == {1}
+          and groups == 1 and max(stride) > 1
+          and os.environ.get("MXNET_CONV1X1_BWD", "0") == "1"):
+        out = _conv1x1_strided_fn(stride, dspec, wspec, caxis,
+                                  data.shape)(data, weight)
     else:
         dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                         (dspec, wspec, dspec))
@@ -312,17 +443,19 @@ def _upsampling(params, *inputs):
 # op input (no extra storage), the rest are per-channel — and recomputes
 # x_hat inline in one fused backward pass with bf16 I/O and f32 math.
 
-_BN_CENTERED_VAR = os.environ.get("MXNET_BN_CENTERED_VAR", "0") == "1"
-
-
 def _bn_stats(axis, eps, data):
     red_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(-1 if i == axis else 1 for i in range(data.ndim))
-    if _BN_CENTERED_VAR:
+    if os.environ.get("MXNET_BN_CENTERED_VAR", "0") == "1":
         # two-pass centered variance: immune to E[x^2]-E[x]^2
-        # cancellation, but the second pass re-reads the activation
-        mean = jnp.mean(data, axis=red_axes, dtype=jnp.float32)
-        diff = data.astype(jnp.float32) - mean.reshape(bshape)
+        # cancellation, but the second pass re-reads the activation.
+        # The barrier stops XLA from fusing the two reductions into the
+        # PRODUCING convolution — a conv+stats "convolution fusion" runs
+        # the MXU at 6-12 TF/s (measured, xplane r50 trace) — so opting
+        # into the safe form doesn't also buy that regression back
+        sx = lax.optimization_barrier(data)
+        mean = jnp.mean(sx, axis=red_axes, dtype=jnp.float32)
+        diff = sx.astype(jnp.float32) - mean.reshape(bshape)
         var = jnp.mean(jnp.square(diff), axis=red_axes)
         return mean, var, red_axes, bshape
     # single-pass moments: sum and sum-of-squares fuse into ONE read of
@@ -408,6 +541,12 @@ def _batch_norm(params, data, gamma, beta, moving_mean, moving_var):
     axis = params.get("axis", 1)
     fix_gamma = params.get("fix_gamma", True)
     use_global = params.get("use_global_stats", False) or not params.get("_is_train", False)
+    # bias folded out of the producing conv by the executor's
+    # conv-bias->BN elision pass (executor._plan_conv_bias_bn_fold): our
+    # input is x where the reference graph normalized x+b. Batch stats:
+    # mean(x+b) = mean(x)+b and var is shift-invariant, so normalization is
+    # unchanged; only the running-mean bookkeeping needs the +b.
+    fold_b = params.get("_fold_bias")
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     axis_n = axis % data.ndim
     bshape = tuple(-1 if i == axis_n else 1 for i in range(data.ndim))
@@ -415,13 +554,21 @@ def _batch_norm(params, data, gamma, beta, moving_mean, moving_var):
         mean, var = moving_mean, moving_var
         inv = lax.rsqrt(var.astype(jnp.float32) + eps)
         scale = g.astype(jnp.float32) * inv
-        shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+        m32 = mean.astype(jnp.float32)
+        if fold_b is not None:
+            # running stats are in the x+b domain; our input is x
+            m32 = m32 - fold_b.astype(jnp.float32)
+        shift = beta.astype(jnp.float32) - m32 * scale
         out = data * scale.astype(data.dtype).reshape(bshape) \
             + shift.astype(data.dtype).reshape(bshape)
         return (out, mean.astype(jnp.float32), var.astype(jnp.float32),
                 moving_mean, moving_var)
     # training: fused-backward core (custom VJP, see _bn_train_core above)
     out, mean, var = _bn_train_core(axis_n, float(eps), data, g, beta)
+    if fold_b is not None:
+        # report/track stats in the x+b domain (running_mean parity with
+        # the unfused reference graph); an O(C) add, not an O(NHWC) one
+        mean = mean + lax.stop_gradient(fold_b).astype(mean.dtype)
     new_mm = lax.stop_gradient(
         momentum * moving_mean + (1 - momentum) * mean.astype(moving_mean.dtype))
     new_mv = lax.stop_gradient(

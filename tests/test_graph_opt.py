@@ -1,0 +1,210 @@
+"""Graph-optimization passes: conv-bias->BN elision and 1x1-conv-as-dot.
+
+The fold pass (executor._plan_conv_bias_bn_fold) removes the mathematically
+-zero-gradient bias of a conv feeding a BatchNorm (the Gluon zoo's
+BottleneckV1 pattern, reference gluon/model_zoo/vision/resnet.py:107,113);
+the 1x1 rewrite (ops/nn._conv1x1_as_dot) lowers pointwise convs to
+dot_general so their autodiff transposes are matmuls, not lhs-dilated
+convolutions. Both must be numerically invisible to users.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _bind_conv_bn(x, w, b, gamma, beta, mm, mv, layout="NCHW",
+                  stride=(1, 1)):
+    """conv(+bias)->BN->sum graph bound with grads (env read at bind)."""
+    data = mx.sym.var("data")
+    weight = mx.sym.var("weight")
+    bias = mx.sym.var("bias")
+    axis = 1 if layout == "NCHW" else 3
+    conv = mx.sym.Convolution(data, weight, bias, kernel=(1, 1),
+                              stride=stride, num_filter=w.shape[0],
+                              layout=layout)
+    bn = mx.sym.BatchNorm(conv, mx.sym.var("gamma"), mx.sym.var("beta"),
+                          mx.sym.var("mm"), mx.sym.var("mv"),
+                          fix_gamma=False, axis=axis, momentum=0.9)
+    # nonlinear head — sum(bn) alone is constant in w AND b (normalized
+    # outputs sum to N*H*W*beta), which would make every grad trivially 0
+    out = mx.sym.sum(mx.sym.Activation(bn, act_type="relu"))
+    return out.bind(
+        mx.cpu(),
+        args={"data": mx.nd.array(x), "weight": mx.nd.array(w),
+              "bias": mx.nd.array(b), "gamma": mx.nd.array(gamma),
+              "beta": mx.nd.array(beta)},
+        args_grad={"data": mx.nd.zeros(x.shape),
+                   "weight": mx.nd.zeros(w.shape),
+                   "bias": mx.nd.zeros(b.shape),
+                   "gamma": mx.nd.zeros(gamma.shape),
+                   "beta": mx.nd.zeros(beta.shape)},
+        aux_states={"mm": mx.nd.array(mm), "mv": mx.nd.array(mv)})
+
+
+def _run_fold(monkeypatch, disabled, train=True):
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (4, 3, 6, 6)).astype(np.float32)
+    w = rng.uniform(-1, 1, (5, 3, 1, 1)).astype(np.float32)
+    b = rng.uniform(-1, 1, (5,)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (5,)).astype(np.float32)
+    beta = rng.uniform(-1, 1, (5,)).astype(np.float32)
+    mm = rng.uniform(-0.5, 0.5, (5,)).astype(np.float32)
+    mv = rng.uniform(0.5, 1.5, (5,)).astype(np.float32)
+    if disabled:
+        monkeypatch.setenv("MXNET_FOLD_CONV_BIAS_BN", "0")
+    else:
+        monkeypatch.delenv("MXNET_FOLD_CONV_BIAS_BN", raising=False)
+    exe = _bind_conv_bn(x, w, b, gamma, beta, mm, mv)
+    if train:
+        exe.forward(is_train=True)
+        exe.backward()
+    else:
+        exe.forward(is_train=False)
+    return exe
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_conv_bias_bn_fold_matches_unfolded(monkeypatch, train):
+    ref = _run_fold(monkeypatch, disabled=True, train=train)
+    opt = _run_fold(monkeypatch, disabled=False, train=train)
+    assert_almost_equal(opt.outputs[0], ref.outputs[0].asnumpy(),
+                        rtol=1e-5, atol=1e-5)
+    # running stats must track the x+b domain exactly like the reference
+    for a, r in zip(opt.aux_arrays, ref.aux_arrays):
+        assert_almost_equal(a, r.asnumpy(), rtol=1e-5, atol=1e-5)
+    if train:
+        names = opt._symbol.list_arguments()
+        for name, ga, gr in zip(names, opt.grad_arrays, ref.grad_arrays):
+            if name == "bias":
+                # both are "mathematically zero + rounding": the unfolded
+                # graph computes the zero through a full reduce (fp32 fuzz
+                # ~1e-4), the folded graph short-circuits it
+                assert np.all(np.abs(ga.asnumpy()) < 1e-3)
+                assert np.all(np.abs(gr.asnumpy()) < 1e-3)
+            else:
+                # rounding order differs (stats of x vs x+b): fp32 noise
+                assert_almost_equal(ga, gr.asnumpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_conv_bias_bn_fold_bias_grad_zero(monkeypatch):
+    monkeypatch.delenv("MXNET_FOLD_CONV_BIAS_BN", raising=False)
+    exe = _run_fold(monkeypatch, disabled=False, train=True)
+    names = exe._symbol.list_arguments()
+    gbias = exe.grad_arrays[names.index("bias")].asnumpy()
+    assert np.all(gbias == 0.0)
+
+
+def test_conv_bias_bn_fold_skips_shared_conv_output(monkeypatch):
+    """Conv output consumed by BOTH a BN and a plain add: fold must not
+    fire (the second consumer sees the biased activation)."""
+    monkeypatch.delenv("MXNET_FOLD_CONV_BIAS_BN", raising=False)
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (2, 3, 4, 4)).astype(np.float32)
+    w = rng.uniform(-1, 1, (3, 3, 1, 1)).astype(np.float32)
+    b = rng.uniform(-1, 1, (3,)).astype(np.float32)
+    ones = np.ones((3,), np.float32)
+    zeros = np.zeros((3,), np.float32)
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, mx.sym.var("weight"), mx.sym.var("bias"),
+                              kernel=(1, 1), num_filter=3)
+    bn = mx.sym.BatchNorm(conv, mx.sym.var("gamma"), mx.sym.var("beta"),
+                          mx.sym.var("mm"), mx.sym.var("mv"),
+                          fix_gamma=False)
+    out = mx.sym.sum(bn + conv)
+    exe = out.bind(mx.cpu(),
+                   args={"data": mx.nd.array(x), "weight": mx.nd.array(w),
+                         "bias": mx.nd.array(b), "gamma": mx.nd.array(ones),
+                         "beta": mx.nd.array(zeros)},
+                   args_grad={n: mx.nd.zeros(s) for n, s in
+                              [("data", x.shape), ("weight", w.shape),
+                               ("bias", b.shape), ("gamma", (3,)),
+                               ("beta", (3,))]},
+                   aux_states={"mm": mx.nd.array(zeros),
+                               "mv": mx.nd.array(ones)})
+    exe.forward(is_train=True)
+    exe.backward()
+    names = exe._symbol.list_arguments()
+    gbias = exe.grad_arrays[names.index("bias")].asnumpy()
+    # the add branch gives the bias a REAL gradient: sum over N,H,W = 2*4*4
+    assert_almost_equal(gbias, np.full((3,), 32.0), rtol=1e-4)
+
+
+@pytest.mark.parametrize("layout,stride", [
+    ("NCHW", (1, 1)), ("NCHW", (2, 2)), ("NHWC", (1, 1)), ("NHWC", (2, 2)),
+])
+def test_conv1x1_as_dot_matches_conv(monkeypatch, layout, stride):
+    rng = np.random.RandomState(11)
+    if layout == "NCHW":
+        x = rng.uniform(-1, 1, (2, 6, 8, 8)).astype(np.float32)
+        w = rng.uniform(-1, 1, (4, 6, 1, 1)).astype(np.float32)
+    else:
+        x = rng.uniform(-1, 1, (2, 8, 8, 6)).astype(np.float32)
+        w = rng.uniform(-1, 1, (4, 1, 1, 6)).astype(np.float32)
+
+    def run():
+        return mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                                 kernel=(1, 1), stride=stride, num_filter=4,
+                                 no_bias=True, layout=layout).asnumpy()
+
+    monkeypatch.setenv("MXNET_CONV1X1_DOT", "0")
+    ref = run()
+    monkeypatch.delenv("MXNET_CONV1X1_DOT", raising=False)
+    opt = run()
+    assert opt.shape == ref.shape
+    assert_almost_equal(opt, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_conv1x1_strided_custom_bwd(monkeypatch, layout):
+    """Custom VJP for strided 1x1 convs: grads must match the autodiff
+    transpose of the plain conv path."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    rng = np.random.RandomState(13)
+    if layout == "NCHW":
+        x = rng.uniform(-1, 1, (2, 3, 7, 7)).astype(np.float32)
+        w = rng.uniform(-1, 1, (4, 3, 1, 1)).astype(np.float32)
+    else:
+        x = rng.uniform(-1, 1, (2, 7, 7, 3)).astype(np.float32)
+        w = rng.uniform(-1, 1, (4, 1, 1, 3)).astype(np.float32)
+    conv = mx.sym.Convolution(mx.sym.var("data"), mx.sym.var("weight"),
+                              kernel=(1, 1), stride=(2, 2), num_filter=4,
+                              no_bias=True, layout=layout)
+    out_shape = (2, 4, 4, 4) if layout == "NCHW" else (2, 4, 4, 4)
+    head_np = rng.uniform(-1, 1, out_shape).astype(np.float32)
+
+    def run_grads():
+        exe = conv.bind(mx.cpu(),
+                        args={"data": mx.nd.array(x), "weight": mx.nd.array(w)},
+                        args_grad={"data": mx.nd.zeros(x.shape),
+                                   "weight": mx.nd.zeros(w.shape)})
+        exe.forward(is_train=True)
+        exe.backward(mx.nd.array(head_np))
+        return (exe.outputs[0].asnumpy(),
+                [g.asnumpy() for g in exe.grad_arrays])
+
+    monkeypatch.setenv("MXNET_CONV1X1_BWD", "0")
+    out_ref, grads_ref = run_grads()
+    monkeypatch.delenv("MXNET_CONV1X1_BWD", raising=False)
+    out_opt, grads_opt = run_grads()
+    assert_almost_equal(out_opt, out_ref, rtol=1e-5, atol=1e-6)
+    for go, gr in zip(grads_opt, grads_ref):
+        assert_almost_equal(go, gr, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(conv, {"data": x, "weight": w},
+                           numeric_eps=1e-2, rtol=5e-2, atol=1e-3)
+
+
+def test_conv1x1_as_dot_gradients(monkeypatch):
+    monkeypatch.delenv("MXNET_CONV1X1_DOT", raising=False)
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (2, 3, 6, 6)).astype(np.float32)
+    w = rng.uniform(-1, 1, (4, 3, 1, 1)).astype(np.float32)
+    conv = mx.sym.Convolution(mx.sym.var("data"), mx.sym.var("weight"),
+                              kernel=(1, 1), stride=(2, 2), num_filter=4,
+                              no_bias=True)
+    check_numeric_gradient(conv, {"data": x, "weight": w},
+                           numeric_eps=1e-2, rtol=5e-2, atol=1e-3)
