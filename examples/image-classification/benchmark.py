@@ -120,8 +120,6 @@ def main():
     print("compiling %d-step scanned Module train program..." % K,
           flush=True)
     feed = batches
-    if args.prestack and K > 1:
-        feed = None  # staged after bind below
     t0 = time.time()
     if K > 1:
         if args.prestack:

@@ -44,6 +44,18 @@ def _truthy(v):
     return v in (True, 1) or str(v).lower() in ("true", "1")
 
 
+def _consumer_map(sym: Symbol, nodes):
+    """(id(node), out_idx) -> list of consuming nodes (None = graph
+    output). Shared by the graph-optimization planners below."""
+    consumers = {}
+    for n in nodes:
+        for src, oi in n.inputs:
+            consumers.setdefault((id(src), oi), []).append(n)
+    for nd_, i in sym._outputs:
+        consumers.setdefault((id(nd_), i), []).append(None)
+    return consumers
+
+
 def _plan_conv_bias_bn_fold(sym: Symbol, nodes):
     """Graph-optimization pass: elide a conv bias that feeds straight into a
     BatchNorm over the same channel axis.
@@ -68,12 +80,7 @@ def _plan_conv_bias_bn_fold(sym: Symbol, nodes):
     import os
     if os.environ.get("MXNET_FOLD_CONV_BIAS_BN", "1") == "0":
         return {}
-    consumers = {}
-    for n in nodes:
-        for src, oi in n.inputs:
-            consumers.setdefault((id(src), oi), []).append(n)
-    for nd_, i in sym._outputs:
-        consumers.setdefault((id(nd_), i), []).append(None)
+    consumers = _consumer_map(sym, nodes)
     folds = {}
     for n in nodes:
         if n.op not in ("BatchNorm", "BatchNorm_v1") or not n.inputs:
@@ -112,6 +119,42 @@ def _plan_conv_bias_bn_fold(sym: Symbol, nodes):
     return folds
 
 
+def _plan_relu_pool_fold(sym: Symbol, nodes, folds):
+    """Graph-optimization pass: fold a relu into the max-Pooling that is
+    its only consumer.
+
+    ``maxpool(relu(x)) == maximum(maxpool(x), 0)`` exactly, and the
+    gradients agree up to measure-zero ties (grad reaches the window's
+    argmax iff the window max is positive — the same positions the relu
+    mask admits). The ResNet stem's relu feeds only the 3x3/2 maxpool; the
+    fold saves a full read+write of the (N,112,112,64) activation forward
+    and the standalone mask multiply backward (~1 ms/step on bf16 bs128).
+    Skip with MXNET_FOLD_RELU_POOL=0."""
+    import os
+    if os.environ.get("MXNET_FOLD_RELU_POOL", "1") == "0":
+        return
+    consumers = _consumer_map(sym, nodes)
+    for n in nodes:
+        if n.op != "Pooling" or id(n) in folds or not n.inputs:
+            continue
+        if n.attrs.get("pool_type", "max") != "max":
+            continue
+        if n.attrs.get("pooling_convention", "valid") != "valid":
+            # ceil-mode can emit windows covering ONLY padding: the
+            # unfolded graph yields -inf there, the clamp would yield 0
+            continue
+        act, oi = n.inputs[0]
+        if act.is_var() or act.op != "Activation" or oi != 0 \
+                or id(act) in folds:
+            continue
+        if act.attrs.get("act_type") != "relu":
+            continue
+        if len(consumers.get((id(act), 0), [])) != 1:
+            continue
+        folds[id(act)] = ("bypass",)
+        folds[id(n)] = ("fold_relu",)
+
+
 def _build_eval(sym: Symbol, ctx=None):
     """Build eval_fn(arg_vals, aux_vals, key, is_train) -> (outs, aux_updates).
 
@@ -121,6 +164,7 @@ def _build_eval(sym: Symbol, ctx=None):
     sym._mark_aux()
     out_index = [(id(n), i) for n, i in sym._outputs]
     folds = _plan_conv_bias_bn_fold(sym, nodes)
+    _plan_relu_pool_fold(sym, nodes, folds)
 
     def eval_fn(arg_vals, aux_vals, key, is_train):
         env = {}
@@ -145,8 +189,13 @@ def _build_eval(sym: Symbol, ctx=None):
             if fold is not None:
                 if fold[0] == "drop_bias":
                     params["no_bias"] = True
-                else:
+                elif fold[0] == "fold_bias":
                     params["_fold_bias"] = env[id(fold[1])][fold[2]]
+                elif fold[0] == "fold_relu":
+                    params["_fold_relu"] = True
+                elif fold[0] == "bypass":
+                    env[id(n)] = [env[id(n.inputs[0][0])][n.inputs[0][1]]]
+                    continue
             ins = [env[id(src)][oi] for src, oi in n.inputs]
             outs = op.fcompute(params, *ins)
             if not isinstance(outs, (tuple, list)):

@@ -648,7 +648,6 @@ class Module(BaseModule):
             else self._scan_plans.get(plan_key)
         if self._fused_plan is False or self.inputs_need_grad:
             return False  # caller steps per-batch (metrics stay per-batch)
-        import numpy as _np
         import jax
         from ..ndarray.ndarray import _from_data
         live_names, indices, fused, _, step_raw = self._fused_plan

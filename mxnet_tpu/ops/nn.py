@@ -385,6 +385,10 @@ def _pooling(params, data):
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         out = lax.reduce_window(data, init, lax.max, window, strides, padding)
+        if params.get("_fold_relu"):
+            # executor relu->maxpool fold: maxpool(relu(x)) ==
+            # max(maxpool(x), 0); grads agree (see _plan_relu_pool_fold)
+            out = jnp.maximum(out, jnp.zeros((), out.dtype))
     elif pool_type in ("avg", "sum"):
         out = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
         if pool_type == "avg":
@@ -465,6 +469,7 @@ def _bn_stats(axis, eps, data):
     # accumulation: it loses ~log2(mean^2/var) bits, fine for
     # normalization-scale activations; set MXNET_BN_CENTERED_VAR=1 for
     # the exact two-pass form (pathological large-mean/low-var inputs).
+    data = _bn_barrier_if_big(data)
     x32 = data.astype(jnp.float32)
     n = 1.0
     for i in red_axes:
@@ -474,6 +479,32 @@ def _bn_stats(axis, eps, data):
     mean = s / n
     var = jnp.maximum(ss / n - mean * mean, 0.0)
     return mean, var, red_axes, bshape
+
+
+def _bn_barrier_elems():
+    try:
+        return int(os.environ.get("MXNET_BN_BARRIER_ELEMS", "0"))
+    except ValueError:
+        return 0
+
+
+def _bn_barrier_if_big(x):
+    """Size-conditioned fusion barrier for BN statistics.
+
+    Letting XLA fuse BN-stat reductions into the producing convolution's
+    epilogue is a net win for small activations (saves a full read), but
+    for the LARGE early-stage activations the combined "convolution
+    fusion" drops the conv to 6-12 TF/s (measured, xplane r50 bs128 —
+    vs ~130 TF/s clean). Measured END-TO-END though, barriers lose:
+    all-barrier cost ~2 ms/step (removed with the single-pass stats) and
+    a 32M-element threshold still measured ~5% slower — the separate
+    reduce pass plus lost epilogue fusion outweighs the cleaner conv.
+    Default 0 (no barrier); MXNET_BN_BARRIER_ELEMS=N barriers tensors
+    above N elements for architectures where the tradeoff flips."""
+    lim = _bn_barrier_elems()
+    if lim and x.size > lim:
+        return lax.optimization_barrier(x)
+    return x
 
 
 def _bn_apply(data, g, beta, mean, var, eps, bshape):
@@ -513,8 +544,14 @@ def _bn_core_bwd(axis, eps, res, cts):
     inv_b = inv.reshape(bshape)
     xhat = (data.astype(jnp.float32) - mean_b) * inv_b  # recomputed, fused
     dy32 = dy.astype(jnp.float32)
-    sum_dy = jnp.sum(dy32, axis=red_axes)
-    sum_dy_xhat = jnp.sum(dy32 * xhat, axis=red_axes)
+    # keep the dgamma/dbeta reductions out of the upstream dgrad-conv
+    # fusion for LARGE dy (same tradeoff as _bn_barrier_if_big forward)
+    sdy = _bn_barrier_if_big(dy)
+    sdy32 = sdy.astype(jnp.float32)
+    sxhat = xhat if sdy is dy else \
+        (_bn_barrier_if_big(data).astype(jnp.float32) - mean_b) * inv_b
+    sum_dy = jnp.sum(sdy32, axis=red_axes)
+    sum_dy_xhat = jnp.sum(sdy32 * sxhat, axis=red_axes)
     coef = (g.astype(jnp.float32) * inv).reshape(bshape)
     dx = coef * (dy32 - sum_dy.reshape(bshape) / n
                  - xhat * (sum_dy_xhat.reshape(bshape) / n))

@@ -133,6 +133,52 @@ def test_conv_bias_bn_fold_skips_shared_conv_output(monkeypatch):
     assert_almost_equal(gbias, np.full((3,), 32.0), rtol=1e-4)
 
 
+@pytest.mark.parametrize("train", [True, False])
+def test_relu_pool_fold_matches_unfolded(monkeypatch, train):
+    """relu folded into its sole-consumer maxpool: outputs and grads must
+    match the explicit relu->maxpool graph."""
+    rng = np.random.RandomState(21)
+    x = rng.uniform(-2, 2, (2, 3, 10, 10)).astype(np.float32)
+    head = rng.uniform(-1, 1, (2, 3, 5, 5)).astype(np.float32)
+    data = mx.sym.var("data")
+    net = mx.sym.Activation(data, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+
+    def run():
+        exe = net.bind(mx.cpu(), args={"data": mx.nd.array(x)},
+                       args_grad={"data": mx.nd.zeros(x.shape)})
+        exe.forward(is_train=train)
+        if train:
+            exe.backward(mx.nd.array(head))
+        return (exe.outputs[0].asnumpy(),
+                exe.grad_arrays[0].asnumpy() if train else None)
+
+    monkeypatch.setenv("MXNET_FOLD_RELU_POOL", "0")
+    out_ref, g_ref = run()
+    monkeypatch.delenv("MXNET_FOLD_RELU_POOL", raising=False)
+    out_opt, g_opt = run()
+    assert_almost_equal(out_opt, out_ref, rtol=1e-6, atol=1e-7)
+    assert (out_opt >= 0).all()
+    if train:
+        assert_almost_equal(g_opt, g_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_relu_pool_fold_skips_shared_relu():
+    """relu consumed by maxpool AND another op must not fold."""
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-2, 2, (2, 3, 8, 8)).astype(np.float32)
+    data = mx.sym.var("data")
+    act = mx.sym.Activation(data, act_type="relu")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    out = mx.sym.Group([pool, mx.sym.sum(act)])
+    exe = out.bind(mx.cpu(), args={"data": mx.nd.array(x)})
+    exe.forward(is_train=False)
+    # the second output must see the REAL relu (nonnegative, elementwise)
+    relu_sum = exe.outputs[1].asnumpy()
+    assert_almost_equal(relu_sum, np.maximum(x, 0).sum(), rtol=1e-5)
+
+
 @pytest.mark.parametrize("layout,stride", [
     ("NCHW", (1, 1)), ("NCHW", (2, 2)), ("NHWC", (1, 1)), ("NHWC", (2, 2)),
 ])
@@ -152,7 +198,7 @@ def test_conv1x1_as_dot_matches_conv(monkeypatch, layout, stride):
 
     monkeypatch.setenv("MXNET_CONV1X1_DOT", "0")
     ref = run()
-    monkeypatch.delenv("MXNET_CONV1X1_DOT", raising=False)
+    monkeypatch.setenv("MXNET_CONV1X1_DOT", "all")
     opt = run()
     assert opt.shape == ref.shape
     assert_almost_equal(opt, ref, rtol=1e-4, atol=1e-5)
@@ -188,7 +234,7 @@ def test_conv1x1_strided_custom_bwd(monkeypatch, layout):
 
     monkeypatch.setenv("MXNET_CONV1X1_BWD", "0")
     out_ref, grads_ref = run_grads()
-    monkeypatch.delenv("MXNET_CONV1X1_BWD", raising=False)
+    monkeypatch.setenv("MXNET_CONV1X1_BWD", "1")
     out_opt, grads_opt = run_grads()
     assert_almost_equal(out_opt, out_ref, rtol=1e-5, atol=1e-6)
     for go, gr in zip(grads_opt, grads_ref):
@@ -198,7 +244,7 @@ def test_conv1x1_strided_custom_bwd(monkeypatch, layout):
 
 
 def test_conv1x1_as_dot_gradients(monkeypatch):
-    monkeypatch.delenv("MXNET_CONV1X1_DOT", raising=False)
+    monkeypatch.setenv("MXNET_CONV1X1_DOT", "all")
     from mxnet_tpu.test_utils import check_numeric_gradient
     rng = np.random.RandomState(5)
     x = rng.uniform(-1, 1, (2, 3, 6, 6)).astype(np.float32)
