@@ -7,14 +7,17 @@ annotations over a `jax.sharding.Mesh` with XLA-inserted collectives.
 """
 from .mesh import MeshContext, get_mesh, data_parallel_mesh, make_mesh
 from . import dist
-from .data_parallel import DataParallelTrainStep, split_and_load_sharded
+from .data_parallel import (DataParallelTrainStep, ShardedTrainStep,
+                            split_and_load_sharded, sgd_update)
 from .ring_attention import (ring_attention, ulysses_attention,
                              local_attention, sequence_sharding)
-from .pipeline import pipeline_apply, stack_stage_params
-from .moe import moe_apply, stack_expert_params
+from .pipeline import pipeline_apply, stack_stage_params, PipelineTrainStep
+from .moe import moe_apply, stack_expert_params, MoETrainStep
 
 __all__ = ["pipeline_apply", "stack_stage_params", "moe_apply", "stack_expert_params",
            "MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
-           "dist", "DataParallelTrainStep", "split_and_load_sharded",
+           "dist", "DataParallelTrainStep", "ShardedTrainStep",
+           "PipelineTrainStep", "MoETrainStep", "sgd_update",
+           "split_and_load_sharded",
            "ring_attention", "ulysses_attention", "local_attention",
            "sequence_sharding"]

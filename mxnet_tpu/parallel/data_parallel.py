@@ -16,7 +16,43 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["DataParallelTrainStep", "split_and_load_sharded"]
+__all__ = ["DataParallelTrainStep", "ShardedTrainStep",
+           "split_and_load_sharded", "sgd_update"]
+
+
+def sgd_update(lr):
+    """Optimizer-update callable for the *TrainStep front doors: plain SGD
+    (stateless; `opt_state` passes through). Swap for any
+    ``update(params, grads, opt_state) -> (new_params, new_opt_state)``."""
+    def update(params, grads, opt_state):
+        new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, opt_state
+    return update
+
+
+def _jit_step(loss_fn, optimizer_update, donate_params):
+    """Shared fwd+bwd+update jit for every *TrainStep front door.
+
+    With ``donate_params=True`` the params/opt_state buffers passed to the
+    step are DONATED (in-place update): the caller's references are invalid
+    after the call — opt in only for steady-state training loops that
+    always thread the returned params into the next call."""
+    def step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        new_params, new_opt_state = optimizer_update(params, grads, opt_state)
+        return loss, new_params, new_opt_state
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate_params else ())
+
+
+def shard_leading_axis(mesh, axis, tree):
+    """Place every leaf of ``tree`` with its LEADING axis sharded over the
+    ``axis`` mesh dimension (rest replicated) — the stacked-stage /
+    stacked-expert placement shared by the pipeline and MoE front doors."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            a, NamedSharding(mesh, P(*((axis,) + (None,) * (a.ndim - 1))))),
+        tree)
 
 
 def split_and_load_sharded(batch_np, mesh, axis_name="dp"):
@@ -42,16 +78,10 @@ class DataParallelTrainStep:
         self.mesh = mesh
         self.param_sharding = NamedSharding(mesh, P())   # replicated
         self.batch_sharding = NamedSharding(mesh, P("dp"))
-
-        def step(params, opt_state, *batch):
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, *batch)
-            new_params, new_opt_state = self.optimizer_update(params, grads, opt_state)
-            return loss, new_params, new_opt_state
-
-        donate = (0, 1) if donate_params else ()
         # input shardings come from place_params/place_batch device_put;
-        # GSPMD propagates them through the step.
-        self._step = jax.jit(step, donate_argnums=donate)
+        # GSPMD propagates them through the step. donate_params invalidates
+        # the params/opt_state passed in (see _jit_step).
+        self._step = _jit_step(loss_fn, optimizer_update, donate_params)
 
     def place_params(self, params):
         return jax.device_put(params, self.param_sharding)
@@ -60,4 +90,54 @@ class DataParallelTrainStep:
         return tuple(jax.device_put(b, self.batch_sharding) for b in batch)
 
     def __call__(self, params, opt_state, *batch):
-        return self._step(params, opt_state, *batch)
+        with self.mesh:
+            return self._step(params, opt_state, *batch)
+
+
+class ShardedTrainStep:
+    """Compile `loss_fn(params, *batch) -> scalar` into a train step with
+    ARBITRARY per-parameter shardings — the tensor-parallelism front door.
+
+    Where :class:`DataParallelTrainStep` replicates every parameter, this
+    class places each parameter leaf by ``param_spec`` (a
+    ``leaf -> PartitionSpec`` callable, or a pytree of PartitionSpecs
+    matching ``params``). Shard a Dense weight's output units on 'tp' and
+    the next weight's input units likewise and XLA inserts the activation
+    ``psum`` over the tp axis — Megatron-style tensor parallelism without
+    hand-written collectives (reference has no analog; its model
+    parallelism is whole-layer placement, symbol.py `group2ctx`).
+
+    ``donate_params=True`` makes the step update in place: the
+    params/opt_state the caller passes in are INVALID afterwards (reuse
+    the returned ones). Default False.
+    """
+
+    def __init__(self, loss_fn, optimizer_update, mesh, param_spec,
+                 batch_axis="dp", donate_params=False):
+        self.loss_fn = loss_fn
+        self.optimizer_update = optimizer_update
+        self.mesh = mesh
+        self._param_spec = param_spec
+        self._batch_axis = batch_axis
+        self._step = _jit_step(loss_fn, optimizer_update, donate_params)
+
+    def _spec_tree(self, params):
+        if callable(self._param_spec):
+            return jax.tree_util.tree_map(self._param_spec, params)
+        return self._param_spec
+
+    def place_params(self, params):
+        """Shard every parameter leaf onto the mesh per param_spec."""
+        return jax.tree_util.tree_map(
+            lambda v, spec: jax.device_put(v, NamedSharding(self.mesh, spec)),
+            params, self._spec_tree(params))
+
+    def place_batch(self, *batch):
+        # built lazily: a pure-tp mesh has no batch axis, and a user who
+        # replicates inputs themselves never needs one
+        sharding = NamedSharding(self.mesh, P(self._batch_axis))
+        return tuple(jax.device_put(b, sharding) for b in batch)
+
+    def __call__(self, params, opt_state, *batch):
+        with self.mesh:
+            return self._step(params, opt_state, *batch)

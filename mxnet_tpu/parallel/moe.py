@@ -17,7 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._compat import shard_map
 
-__all__ = ["moe_apply", "stack_expert_params"]
+__all__ = ["moe_apply", "stack_expert_params", "MoETrainStep"]
 
 
 def stack_expert_params(per_expert_params):
@@ -84,3 +84,48 @@ def moe_apply(expert_fn, expert_params, gate_w, x, mesh, axis="ep",
                 P(), P())
     fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs, out_specs=P())
     return fn(expert_params, gate_w, x)
+
+
+class MoETrainStep:
+    """User-facing expert-parallelism front door (mirrors
+    DataParallelTrainStep): compile the routed MoE forward + backward +
+    optimizer update into ONE jitted program over the ``axis`` mesh
+    dimension.
+
+    - ``expert_fn(params_e, tokens) -> tokens'`` — one expert.
+    - ``loss_fn(outputs, *labels) -> scalar`` over the combined (N, D)
+      output.
+    - ``optimizer_update(params, grads, opt_state)`` applied to the
+      ``(expert_params, gate_w)`` pair — e.g.
+      :func:`mxnet_tpu.parallel.sgd_update`.
+
+    Use :meth:`place_experts` to stack per-expert parameter trees and
+    shard them over the ep axis (E/num_ranks local experts per rank).
+    ``donate_params=True`` invalidates the params/opt_state passed to the
+    step (in-place update); default False."""
+
+    def __init__(self, expert_fn, loss_fn, optimizer_update, mesh,
+                 axis="ep", top_k=2, capacity_factor=2.0,
+                 donate_params=False):
+        from .data_parallel import _jit_step
+        self.mesh = mesh
+        self.axis = axis
+
+        def full_loss(expert_and_gate, x, *labels):
+            experts, gate_w = expert_and_gate
+            out = moe_apply(expert_fn, experts, gate_w, x, mesh, axis,
+                            top_k=top_k, capacity_factor=capacity_factor)
+            return loss_fn(out, *labels)
+
+        self._step = _jit_step(full_loss, optimizer_update, donate_params)
+
+    def place_experts(self, per_expert_params):
+        """[expert0_tree, ...] -> stacked tree, leading (expert) axis
+        sharded over the ep mesh axis."""
+        from .data_parallel import shard_leading_axis
+        return shard_leading_axis(self.mesh, self.axis,
+                                  stack_expert_params(per_expert_params))
+
+    def __call__(self, expert_and_gate, opt_state, x, *labels):
+        with self.mesh:
+            return self._step(expert_and_gate, opt_state, x, *labels)

@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._compat import shard_map
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "stack_stage_params", "PipelineTrainStep"]
 
 
 def stack_stage_params(per_stage_params):
@@ -102,3 +102,45 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp"):
                 P())
     fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs, out_specs=P())
     return fn(stacked_params, x)
+
+
+class PipelineTrainStep:
+    """User-facing pipeline-parallelism front door (mirrors
+    DataParallelTrainStep): compile a GPipe-scheduled forward + backward +
+    optimizer update into ONE jitted program over the ``axis`` mesh
+    dimension.
+
+    - ``stage_fn(stage_params, h) -> h'`` — one stage's forward.
+    - ``loss_fn(outputs, *labels) -> scalar`` over the final-stage
+      microbatch outputs ``(num_microbatches, micro_batch, ...)``.
+    - ``optimizer_update(params, grads, opt_state)`` — e.g.
+      :func:`mxnet_tpu.parallel.sgd_update`.
+
+    Use :meth:`place_stages` to stack per-stage parameter trees and shard
+    them one-stage-per-rank; gradients flow through the ``ppermute``
+    schedule, so the backward pipeline needs no extra code.
+    ``donate_params=True`` invalidates the params/opt_state passed to the
+    step (in-place update); default False."""
+
+    def __init__(self, stage_fn, loss_fn, optimizer_update, mesh,
+                 axis="pp", donate_params=False):
+        from .data_parallel import _jit_step
+        self.mesh = mesh
+        self.axis = axis
+
+        def full_loss(stacked, xs, *labels):
+            outs = pipeline_apply(stage_fn, stacked, xs, mesh, axis)
+            return loss_fn(outs, *labels)
+
+        self._step = _jit_step(full_loss, optimizer_update, donate_params)
+
+    def place_stages(self, per_stage_params):
+        """[stage0_tree, ...] -> stacked tree, leading axis sharded over
+        the pipeline mesh axis (one stage per rank)."""
+        from .data_parallel import shard_leading_axis
+        return shard_leading_axis(self.mesh, self.axis,
+                                  stack_stage_params(per_stage_params))
+
+    def __call__(self, stacked_params, opt_state, xs, *labels):
+        with self.mesh:
+            return self._step(stacked_params, opt_state, xs, *labels)

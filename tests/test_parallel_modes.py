@@ -131,3 +131,83 @@ def test_shape_validation(pp_mesh):
         with pp_mesh:
             moe_apply(_expert_fn, experts, gate_w, jnp.ones((4, 4)),
                       pp_mesh, axis="pp", top_k=2)
+
+
+# --- user-facing *TrainStep front doors (VERDICT r3 next #6) --------------
+
+def test_pipeline_train_step_front_door(pp_mesh):
+    """PipelineTrainStep: loss decreases and every stage's params move."""
+    from mxnet_tpu.parallel import PipelineTrainStep, sgd_update
+    rng = np.random.RandomState(0)
+    D = 4
+    step = PipelineTrainStep(_stage_fn, lambda o: jnp.mean(o * o),
+                             sgd_update(0.5), pp_mesh, "pp",
+                             donate_params=False)
+    stages = step.place_stages(_stages(rng, 4, D))
+    xs = jnp.asarray(rng.randn(6, 2, D).astype("f"))
+    l0, p1, _ = step(stages, None, xs)
+    l1, p2, _ = step(p1, None, xs)
+    assert np.isfinite(float(l0)) and np.isfinite(float(l1))
+    assert float(l1) < float(l0)
+    for a, b in zip(jax.tree_util.tree_leaves(stages),
+                    jax.tree_util.tree_leaves(p1)):
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_moe_train_step_front_door(ep_mesh):
+    """MoETrainStep: experts and gate both receive gradient."""
+    from mxnet_tpu.parallel import MoETrainStep, sgd_update
+    rng = np.random.RandomState(1)
+    D, E = 4, 8
+    step = MoETrainStep(lambda p, t: t @ p["w"],
+                        lambda o: jnp.mean(o * o), sgd_update(0.5),
+                        ep_mesh, "ep", top_k=2, donate_params=False)
+    experts = step.place_experts(
+        [{"w": jnp.asarray(rng.randn(D, D).astype("f") * 0.3)}
+         for _ in range(E)])
+    gate_w = jnp.asarray(rng.randn(D, E).astype("f") * 0.1)
+    x = jnp.asarray(rng.randn(16, D).astype("f"))
+    l0, (e1, g1), _ = step((experts, gate_w), None, x)
+    assert np.isfinite(float(l0))
+    assert not np.allclose(np.asarray(gate_w), np.asarray(g1))
+    assert not np.allclose(
+        np.asarray(jax.tree_util.tree_leaves(experts)[0]),
+        np.asarray(jax.tree_util.tree_leaves(e1)[0]))
+
+
+def test_sharded_train_step_tp_matches_single_device():
+    """ShardedTrainStep with Megatron-style 2-way tp == unsharded math."""
+    from mxnet_tpu.parallel import ShardedTrainStep, sgd_update
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.asarray(devs[:4]).reshape(2, 2), ("dp", "tp"))
+    rng = np.random.RandomState(2)
+    w1 = rng.randn(8, 16).astype("f") * 0.3     # (in, hidden)
+    w2 = rng.randn(16, 4).astype("f") * 0.3     # (hidden, out)
+    x = rng.randn(4, 8).astype("f")
+    y = rng.randn(4, 4).astype("f")
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        out = h @ params["w2"]
+        return jnp.mean((out - y) ** 2)
+
+    spec = {"w1": P(None, "tp"), "w2": P("tp", None)}
+    step = ShardedTrainStep(loss_fn, sgd_update(0.1), mesh, spec,
+                            donate_params=False)
+    params = step.place_params({"w1": jnp.asarray(w1), "w2": jnp.asarray(w2)})
+    xb, yb = step.place_batch(x, y)
+    loss, new_params, _ = step(params, None, xb, yb)
+
+    # single-device oracle
+    import numpy as _np
+    p0 = {"w1": jnp.asarray(w1), "w2": jnp.asarray(w2)}
+    l_ref, g_ref = jax.value_and_grad(loss_fn)(p0, jnp.asarray(x),
+                                               jnp.asarray(y))
+    assert abs(float(loss) - float(l_ref)) < 1e-5
+    for k in ("w1", "w2"):
+        ref = _np.asarray(p0[k]) - 0.1 * _np.asarray(g_ref[k])
+        assert _np.allclose(_np.asarray(new_params[k]), ref,
+                            rtol=1e-4, atol=1e-5)
