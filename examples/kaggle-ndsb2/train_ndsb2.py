@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Second National Data Science Bowl: cardiac MRI volume estimation.
+
+Reference analog: example/kaggle-ndsb2/Train.py — a LeNet-style net over
+the frame-to-frame DIFFERENCES of a 30-frame cardiac MRI cine sequence,
+predicting the volume's cumulative distribution (600 logistic outputs,
+one per mL threshold), scored with the competition's CRPS metric after
+enforcing CDF monotonicity.
+
+Synthetic data (no Kaggle download): each sample is a 30-frame sequence
+of a pulsing disc whose radius oscillates through the cardiac cycle; the
+target "volume" is proportional to the disc's area amplitude, so the net
+must read MOTION (frame differences) to regress it — the same signal
+path as the real task.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+np.random.seed(0)
+
+FRAMES = 30
+BINS = 60  # mL thresholds (reference uses 600; scaled to the synthetic range)
+
+
+def make_sequence(rng, size):
+    """One cine loop: disc radius oscillates with a random amplitude."""
+    base = rng.uniform(0.18, 0.30) * size
+    amp = rng.uniform(0.05, 0.45) * base
+    cx, cy = size / 2 + rng.uniform(-2, 2), size / 2 + rng.uniform(-2, 2)
+    yy, xx = np.mgrid[0:size, 0:size]
+    frames = np.empty((FRAMES, size, size), np.float32)
+    for t in range(FRAMES):
+        r = base + amp * np.sin(2 * np.pi * t / FRAMES)
+        frames[t] = ((xx - cx) ** 2 + (yy - cy) ** 2 <= r * r) * 255.0
+    volume = amp  # the quantity the net must recover from the motion
+    return frames, volume
+
+
+def encode_cdf(volumes, lo, hi):
+    """Reference encode_label: step-function CDF target per threshold."""
+    thresholds = np.linspace(lo, hi, BINS)
+    return (volumes[:, None] < thresholds[None, :]).astype(np.float32)
+
+
+def crps(label, pred):
+    """Reference CRPS: monotonic-rectified mean squared CDF distance."""
+    pred = np.maximum.accumulate(pred, axis=1)
+    return np.sum(np.square(label - pred)) / label.size
+
+
+def build_net(size):
+    """Reference get_lenet: normalize, frame diffs, 2x conv-BN-relu-pool,
+    dropout, 60 logistic outputs (the volume CDF)."""
+    source = mx.sym.var("data")
+    source = (source - 128.0) * (1.0 / 128)
+    frames = mx.sym.SliceChannel(source, num_outputs=FRAMES)
+    diffs = [frames[i + 1] - frames[i] for i in range(FRAMES - 1)]
+    net = mx.sym.concat(*diffs, dim=1)
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=16)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.Dropout(net)
+    net = mx.sym.FullyConnected(net, num_hidden=BINS)
+    return mx.sym.LogisticRegressionOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-examples", type=int, default=400)
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=20)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(11)
+    X = np.empty((args.num_examples, FRAMES, args.image_size,
+                  args.image_size), np.float32)
+    vols = np.empty(args.num_examples, np.float32)
+    for i in range(args.num_examples):
+        X[i], vols[i] = make_sequence(rng, args.image_size)
+    Y = encode_cdf(vols, vols.min(), vols.max())
+
+    n_train = int(0.8 * args.num_examples)
+    train = mx.io.NDArrayIter(X[:n_train], Y[:n_train], args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[n_train:], Y[n_train:], args.batch_size,
+                            label_name="softmax_label")
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = mx.mod.Module(build_net(args.image_size), context=ctx)
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric=mx.metric.np(crps),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    # held-out CRPS, monotonic-rectified like the reference submission path
+    preds, labels = [], []
+    val.reset()
+    for batch in val:
+        mod.forward(batch, is_train=False)
+        preds.append(mod.get_outputs()[0].asnumpy())
+        labels.append(batch.label[0].asnumpy())
+    score = crps(np.concatenate(labels), np.concatenate(preds))
+    print("final NDSB2 val CRPS: %.4f" % score)
+    # an untrained CDF predictor scores ~0.25 (all-0.5 outputs); learning
+    # the motion-amplitude signal must beat that decisively
+    assert score < 0.15, "CRPS %.4f did not improve over chance" % score
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Adversarial variational autoencoder (VAE-GAN).
+
+Reference analog: example/mxnet_adversarial_vae/vaegan_mxnet.py — an
+encoder E producing a Gaussian latent, a generator/decoder G, and a
+discriminator D whose INTERMEDIATE layer features define the
+reconstruction loss (Larsen et al. 2016: "autoencoding beyond pixels"):
+
+    L_E = KL(q(z|x) || N(0,1)) + ||D_l(x) - D_l(G(E(x)))||^2
+    L_G = gan(G fools D) + feature reconstruction
+    L_D = gan(real vs fake vs reconstructed)
+
+TPU-first form: the three sub-networks are Gluon HybridBlocks and each
+optimization phase is one fused autograd.record()+step — no separate
+Module groups and manual grad arrays (the reference wires three Modules
+and hand-copies gradients between them).
+
+Synthetic data (no download): 16x16 images of axis-aligned bars whose
+position/thickness span a 2-factor manifold — enough structure for the
+latent to organize and the discriminator features to be informative.
+"""
+from __future__ import print_function
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+np.random.seed(0)
+from mxnet_tpu import autograd, gluon
+
+
+class Encoder(gluon.HybridBlock):
+    def __init__(self, nef, z_dim):
+        super().__init__()
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(gluon.nn.Conv2D(nef, 3, 2, 1, activation="relu"),
+                      gluon.nn.Conv2D(nef * 2, 3, 2, 1, activation="relu"),
+                      gluon.nn.Flatten())
+        self.mu = gluon.nn.Dense(z_dim)
+        self.logvar = gluon.nn.Dense(z_dim)
+
+    def hybrid_forward(self, F, x):
+        h = self.body(x)
+        return self.mu(h), self.logvar(h)
+
+
+def make_generator(ngf, z_dim):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(ngf * 4 * 4, activation="relu"),
+            gluon.nn.HybridLambda(
+                lambda F, x: x.reshape((0, -1, 4, 4))),
+            gluon.nn.Conv2DTranspose(ngf, 4, 2, 1, activation="relu"),
+            gluon.nn.Conv2DTranspose(1, 4, 2, 1, activation="sigmoid"))
+    return net
+
+
+class Discriminator(gluon.HybridBlock):
+    """Returns (decision logit, intermediate features for the
+    reconstruction loss — the reference's discriminator1/2 split)."""
+
+    def __init__(self, ndf):
+        super().__init__()
+        self.feat = gluon.nn.HybridSequential()
+        self.feat.add(gluon.nn.Conv2D(ndf, 3, 2, 1, activation="relu"),
+                      gluon.nn.Conv2D(ndf * 2, 3, 2, 1, activation="relu"),
+                      gluon.nn.Flatten())
+        self.head = gluon.nn.Dense(1)
+
+    def hybrid_forward(self, F, x):
+        f = self.feat(x)
+        return self.head(f), f
+
+
+def make_bars(rng, num, size=16):
+    X = np.zeros((num, 1, size, size), np.float32)
+    for i in range(num):
+        if rng.rand() < 0.5:
+            p = rng.randint(1, size - 3)
+            X[i, 0, p:p + rng.randint(1, 3), :] = 1.0
+        else:
+            p = rng.randint(1, size - 3)
+            X[i, 0, :, p:p + rng.randint(1, 3)] = 1.0
+    return X
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-examples", type=int, default=512)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--z-dim", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    rng = np.random.RandomState(3)
+    X = make_bars(rng, args.num_examples)
+
+    enc = Encoder(8, args.z_dim)
+    gen = make_generator(16, args.z_dim)
+    dis = Discriminator(8)
+    for net in (enc, gen, dis):
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+    t_e = gluon.Trainer(enc.collect_params(), "adam",
+                        {"learning_rate": args.lr})
+    t_g = gluon.Trainer(gen.collect_params(), "adam",
+                        {"learning_rate": args.lr})
+    # slower D: an over-confident discriminator starves the feature
+    # reconstruction signal in short runs
+    t_d = gluon.Trainer(dis.collect_params(), "adam",
+                        {"learning_rate": args.lr * 0.25})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    B = args.batch_size
+    if len(X) < B:
+        raise SystemExit("--num-examples (%d) must be >= --batch-size (%d)"
+                         % (len(X), B))
+    X0 = X[:B].copy()  # fixed eval subset: training shuffles X in place
+
+    def pixel_recon_err():
+        mu0, _ = enc(mx.nd.array(X0, ctx=ctx))
+        xr0 = gen(mu0).asnumpy()
+        return float(np.mean((X0 - xr0) ** 2))
+
+    err0 = pixel_recon_err()  # untrained reference point
+    steps = 0
+    last = {}
+    for epoch in range(args.num_epochs):
+        rng.shuffle(X)
+        for s in range(0, len(X) - B + 1, B):
+            xb = mx.nd.array(X[s:s + B], ctx=ctx)
+            ones = mx.nd.ones((B,), ctx=ctx)
+            zeros = mx.nd.zeros((B,), ctx=ctx)
+
+            # --- D: real vs reconstructed vs prior samples ---------------
+            with autograd.record():
+                mu, logvar = enc(xb)
+                eps_ = mx.nd.random.normal(shape=(B, args.z_dim), ctx=ctx)
+                z = mu + eps_ * (0.5 * logvar).exp()
+                xr = gen(z)
+                zp = mx.nd.random.normal(shape=(B, args.z_dim), ctx=ctx)
+                xp = gen(zp)
+                d_real, _ = dis(xb)
+                d_rec, _ = dis(xr.detach())
+                d_fake, _ = dis(xp.detach())
+                loss_d = (bce(d_real, ones) + bce(d_rec, zeros)
+                          + bce(d_fake, zeros)).mean()
+            loss_d.backward()
+            t_d.step(B)
+
+            # --- E+G: KL + D-feature reconstruction + fool D -------------
+            with autograd.record():
+                mu, logvar = enc(xb)
+                eps_ = mx.nd.random.normal(shape=(B, args.z_dim), ctx=ctx)
+                z = mu + eps_ * (0.5 * logvar).exp()
+                xr = gen(z)
+                zp = mx.nd.random.normal(shape=(B, args.z_dim), ctx=ctx)
+                xp = gen(zp)
+                _, f_real = dis(xb)
+                d_rec, f_rec = dis(xr)
+                d_fake, _ = dis(xp)
+                kl = (-0.5 * (1 + logvar - mu * mu - logvar.exp())
+                      .sum(axis=1)).mean()
+                recon = ((f_real.detach() - f_rec) ** 2).mean()
+                # pixel term stabilizes the short-run optimization (the
+                # reference's GaussianLogDensity layer loss plays the same
+                # role alongside the discriminator-feature loss)
+                pixel = ((xb - xr) ** 2).mean()
+                fool = (bce(d_rec, ones) + bce(d_fake, ones)).mean()
+                loss_eg = 0.02 * kl + recon + 20.0 * pixel + 0.1 * fool
+            loss_eg.backward()
+            t_e.step(B)
+            t_g.step(B)
+            steps += 1
+            last = {"d": float(loss_d.asnumpy()),
+                    "kl": float(kl.asnumpy()),
+                    "recon": float(recon.asnumpy())}
+        print("epoch %d: D %.3f  KL %.3f  recon(feat) %.4f"
+              % (epoch, last["d"], last["kl"], last["recon"]))
+
+    assert np.isfinite(list(last.values())).all()
+    err = pixel_recon_err()
+    print("final VAE-GAN pixel recon MSE %.4f (untrained %.4f)"
+          % (err, err0))
+    # smoke criterion: the E->G path must have learned to reconstruct —
+    # at least 2x better than the untrained net (full convergence needs
+    # far more steps than a smoke run)
+    assert err < 0.5 * err0, "reconstruction did not improve (%.4f vs %.4f)" \
+        % (err, err0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Shared example utilities (reference example/utils)."""
+from .get_data import (get_mnist, get_mnist_iterator,
+                       get_cifar10_iterator)
+
+__all__ = ["get_mnist", "get_mnist_iterator", "get_cifar10_iterator"]

@@ -1,0 +1,108 @@
+"""Shared dataset helpers for the examples (reference example/utils/get_data.py).
+
+The reference downloads MNIST/CIFAR archives from data.mxnet.io; this
+framework's examples run hermetically, so these helpers synthesize
+datasets with the same shapes/iterator contracts instead — deterministic,
+no network, and the learning tasks stay nontrivial (class-conditional
+structure, not noise). Pass a real `data_dir` containing the standard
+idx/bin files to use actual data when available.
+"""
+from __future__ import print_function
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+def _synthetic_digits(num, rng, size=28):
+    """Class-conditional 'digits': each class c lights a distinct pair of
+    blobs; recoverable by an MLP yet not linearly trivial."""
+    X = np.zeros((num, 1, size, size), np.float32)
+    y = rng.randint(0, 10, num)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(num):
+        c = y[i]
+        for k in range(2):
+            cx = (3 + 5 * ((c + 3 * k) % 5)) + rng.uniform(-1, 1)
+            cy = (7 + 14 * ((c + k) % 2)) + rng.uniform(-1, 1)
+            r2 = (xx - cx) ** 2 + (yy - cy) ** 2
+            X[i, 0] += np.exp(-r2 / 8.0)
+        X[i, 0] += rng.uniform(0, 0.1, (size, size))
+    return X / X.max(), y.astype(np.float32)
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        _, n, h, w = struct.unpack(">IIII", f.read(16))
+        return np.frombuffer(f.read(), np.uint8).reshape(n, 1, h, w) / 255.0
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), np.uint8).astype(np.float32)
+
+
+def get_mnist(data_dir=None, num_train=6000, num_val=1000, seed=0):
+    """(train_X, train_y, val_X, val_y) — real MNIST when `data_dir` holds
+    the idx files (reference layout), synthetic digits otherwise."""
+    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    if data_dir is not None:
+        paths = []
+        for n in names:
+            for cand in (os.path.join(data_dir, n),
+                         os.path.join(data_dir, n + ".gz")):
+                if os.path.exists(cand):
+                    paths.append(cand)
+                    break
+        if len(paths) == 4:
+            return (_read_idx_images(paths[0]).astype(np.float32),
+                    _read_idx_labels(paths[1]),
+                    _read_idx_images(paths[2]).astype(np.float32),
+                    _read_idx_labels(paths[3]))
+    rng = np.random.RandomState(seed)
+    trX, trY = _synthetic_digits(num_train, rng)
+    vaX, vaY = _synthetic_digits(num_val, rng)
+    return trX, trY, vaX, vaY
+
+
+def get_mnist_iterator(batch_size, input_shape=(1, 28, 28), data_dir=None,
+                       num_train=6000, num_val=1000, seed=0):
+    """(train_iter, val_iter) NDArrayIters — reference get_mnist_iterator
+    contract (used by example/module, example/gluon, ...)."""
+    import mxnet_tpu as mx
+    trX, trY, vaX, vaY = get_mnist(data_dir, num_train, num_val, seed)
+    shape = (-1,) + tuple(input_shape)
+    train = mx.io.NDArrayIter(trX.reshape(shape), trY, batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(vaX.reshape(shape), vaY, batch_size,
+                            label_name="softmax_label")
+    return train, val
+
+
+def get_cifar10_iterator(batch_size, num_train=2000, num_val=400, seed=0):
+    """(train_iter, val_iter) of synthetic 3x32x32 'cifar' images: class =
+    dominant color/position pattern."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+
+    def make(num):
+        X = rng.uniform(0, 0.3, (num, 3, 32, 32)).astype(np.float32)
+        y = rng.randint(0, 10, num)
+        for i in range(num):
+            c = y[i]
+            X[i, c % 3, (c // 3) * 8:(c // 3) * 8 + 10, :] += 0.7
+        return X, y.astype(np.float32)
+
+    trX, trY = make(num_train)
+    vaX, vaY = make(num_val)
+    train = mx.io.NDArrayIter(trX, trY, batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(vaX, vaY, batch_size,
+                            label_name="softmax_label")
+    return train, val

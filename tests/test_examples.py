@@ -322,3 +322,40 @@ def test_kaggle_pipeline():
              "--num-epochs", "6", timeout=900)
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "KAGGLE PIPELINE OK" in r.stdout
+
+
+def test_chinese_text_cnn():
+    r = _run("cnn_chinese_text_classification/text_cnn_zh.py",
+             "--num-examples", "800", "--num-epochs", "4", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "final chinese text-cnn accuracy" in r.stdout
+    acc = float(r.stdout.rsplit("accuracy:", 1)[1])
+    assert acc > 0.8, acc
+
+
+def test_kaggle_ndsb2():
+    r = _run("kaggle-ndsb2/train_ndsb2.py", "--num-examples", "200",
+             "--num-epochs", "6", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "final NDSB2 val CRPS" in r.stdout
+
+
+def test_adversarial_vae():
+    r = _run("mxnet_adversarial_vae/vaegan.py", "--num-examples", "512",
+             "--num-epochs", "6", "--batch-size", "32", timeout=900)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "final VAE-GAN pixel recon MSE" in r.stdout
+
+
+def test_utils_get_data():
+    sys.path.insert(0, EXAMPLES)
+    try:
+        from utils import get_mnist_iterator, get_cifar10_iterator
+        train, val = get_mnist_iterator(25, num_train=100, num_val=50)
+        b = next(iter(train))
+        assert b.data[0].shape == (25, 1, 28, 28)
+        ctrain, _ = get_cifar10_iterator(20, num_train=60, num_val=20)
+        cb = next(iter(ctrain))
+        assert cb.data[0].shape == (20, 3, 32, 32)
+    finally:
+        sys.path.remove(EXAMPLES)

@@ -58,8 +58,8 @@ def bench_resnet50_train():
                  "examples/image-classification/benchmark.py",
                  "--model", "resnet50_v1", "--batch-size", "128",
                  "--dtype", "bfloat16", "--layout", "NHWC",
-                 "--batches-per-dispatch", "20", "--num-calls", "5",
-                 "--scan-unroll", "5"])
+                 "--batches-per-dispatch", "30", "--num-calls", "15",
+                 "--scan-unroll", "3", "--donate", "--prestack"])
     m = re.search(r"([\d.]+) img/s train", r.stdout)
     if not m:
         raise RuntimeError("train benchmark produced no rate:\n"
