@@ -1,0 +1,110 @@
+/*!
+ * \file Predictor.hpp
+ * \brief Header-only C++ RAII wrapper over the MXPred* predict ABI
+ * (libmxtpu_predict.so, src/c_predict_api.cc).
+ *
+ * The analog of the reference cpp-package's inference path
+ * (cpp-package/example/inference there): load (symbol JSON, .params),
+ * set inputs, forward, read outputs — with exceptions and std::vector
+ * instead of int return codes. Device compute runs as one jitted XLA
+ * program behind the C boundary.
+ *
+ * Link: -lmxtpu_predict (build with `make -C src predict`). The host
+ * process must expose a PYTHONPATH resolving mxnet_tpu and jax — the
+ * predict ABI embeds CPython (see c_predict_api.cc header comment).
+ */
+#ifndef MXTPU_CPP_PREDICTOR_HPP_
+#define MXTPU_CPP_PREDICTOR_HPP_
+
+#include <cstddef>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+const char *MXGetLastError(void);
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+}
+
+namespace mxtpu {
+namespace cpp {
+
+class Predictor {
+ public:
+  /*! \param dev_type 1 = cpu, 2 = accelerator (TPU) */
+  Predictor(const std::string &symbol_json, const std::string &param_blob,
+            const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+            int dev_type = 1, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shapes;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shapes.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shapes.size()));
+    }
+    if (MXPredCreate(symbol_json.c_str(), param_blob.data(),
+                     static_cast<int>(param_blob.size()), dev_type, dev_id,
+                     static_cast<mx_uint>(keys.size()), keys.data(),
+                     indptr.data(), shapes.data(), &handle_) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  void SetInput(const std::string &key, const std::vector<mx_float> &data) {
+    if (MXPredSetInput(handle_, key.c_str(), data.data(),
+                       static_cast<mx_uint>(data.size())) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  void Forward() {
+    if (MXPredForward(handle_) != 0)
+      throw std::runtime_error(MXGetLastError());
+  }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) {
+    mx_uint *data = nullptr, ndim = 0;
+    if (MXPredGetOutputShape(handle_, index, &data, &ndim) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index = 0) {
+    auto shape = GetOutputShape(index);
+    mx_uint total = 1;
+    for (mx_uint d : shape) total *= d;
+    std::vector<mx_float> out(total);
+    if (MXPredGetOutput(handle_, index, out.data(), total) != 0)
+      throw std::runtime_error(MXGetLastError());
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_PREDICTOR_HPP_

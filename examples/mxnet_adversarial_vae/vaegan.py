@@ -50,7 +50,7 @@ class Encoder(gluon.HybridBlock):
         return self.mu(h), self.logvar(h)
 
 
-def make_generator(ngf, z_dim):
+def make_generator(ngf):
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Dense(ngf * 4 * 4, activation="relu"),
             gluon.nn.HybridLambda(
@@ -103,7 +103,7 @@ def main():
     X = make_bars(rng, args.num_examples)
 
     enc = Encoder(8, args.z_dim)
-    gen = make_generator(16, args.z_dim)
+    gen = make_generator(16)
     dis = Discriminator(8)
     for net in (enc, gen, dis):
         net.initialize(mx.init.Xavier(), ctx=ctx)
@@ -129,7 +129,6 @@ def main():
         return float(np.mean((X0 - xr0) ** 2))
 
     err0 = pixel_recon_err()  # untrained reference point
-    steps = 0
     last = {}
     for epoch in range(args.num_epochs):
         rng.shuffle(X)
@@ -177,7 +176,6 @@ def main():
             loss_eg.backward()
             t_e.step(B)
             t_g.step(B)
-            steps += 1
             last = {"d": float(loss_d.asnumpy()),
                     "kl": float(kl.asnumpy()),
                     "recon": float(recon.asnumpy())}

@@ -168,3 +168,63 @@ class Predictor:
 
     def __exit__(self, *a):
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# C-boundary helpers (src/c_predict_api.cc).
+#
+# The native MXPred* surface embeds CPython and delegates here, keeping the
+# C++ side to generic object calls — the same layering as the reference,
+# where c_predict_api.cc delegates to the full engine behind the C ABI.
+# ---------------------------------------------------------------------------
+def _c_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
+              input_shapes, output_names):
+    """MXPredCreate(PartialOut): dev_type 1 = cpu, 2 = accelerator (the
+    reference's GPU slot maps to this framework's TPU context)."""
+    from .context import cpu as _cpu, tpu as _tpu, num_tpus
+    if dev_type == 2 and num_tpus():
+        ctx = _tpu(dev_id)
+    else:
+        ctx = _cpu(dev_id)
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    return Predictor(symbol_json, param_bytes, ctx=ctx, input_shapes=shapes,
+                     output_names=list(output_names) or None)
+
+
+def _c_set_input(pred, key, memview, size):
+    arr = np.frombuffer(memview, dtype=np.float32, count=int(size))
+    bound = pred._exec.arg_dict.get(key)
+    if bound is None:
+        raise MXNetError("no input named %r" % key)
+    if int(size) != int(np.prod(bound.shape)):
+        raise MXNetError("input %r size %d != bound size %d"
+                         % (key, int(size), int(np.prod(bound.shape))))
+    pred.set_input(key, arr.reshape(bound.shape))
+
+
+def _c_get_output_bytes(pred, index):
+    out = np.ascontiguousarray(pred.get_output(int(index)),
+                               dtype=np.float32)
+    return out.tobytes()
+
+
+def _c_output_shape(pred, index):
+    return tuple(int(d) for d in pred.get_output_shape(int(index)))
+
+
+def _c_reshape(pred, input_keys, input_shapes):
+    """MXPredReshape: a NEW independent predictor sharing the loaded
+    parameter arrays (no reload/recopy); the original handle keeps its
+    shapes — reference c_predict_api.cc semantics."""
+    new = Predictor.__new__(Predictor)
+    new._ctx = pred._ctx
+    new._symbol = pred._symbol
+    new._params = pred._params          # shared weights, reference-style
+    shapes = dict(pred._input_shapes)
+    shapes.update({k: tuple(int(d) for d in s)
+                   for k, s in zip(input_keys, input_shapes)})
+    new._input_shapes = shapes
+    new._exec = None
+    new._bind()
+    return new

@@ -1,0 +1,274 @@
+/*!
+ * MXPred* C predict surface (reference include/mxnet/c_predict_api.h,
+ * impl src/c_api/c_predict_api.cc): create a predictor from (symbol JSON,
+ * .params blob), set inputs, forward, read outputs — the standalone
+ * deployment ABI the reference's amalgamation/mobile builds expose.
+ *
+ * TPU-native layering: device compute is XLA, driven by the Python
+ * inference runtime (mxnet_tpu/predict.py). This library embeds CPython
+ * and delegates each C call to the `_c_*` helpers there — the same
+ * boundary the reference draws (its c_predict_api.cc delegates to the
+ * full engine behind the C ABI; here the "engine" is the jitted XLA
+ * program). The embedded interpreter resolves mxnet_tpu/jax via the
+ * standard PYTHONPATH environment of the host process.
+ *
+ * Thread model: calls may come from any thread; every entry point takes
+ * the GIL. The first MXPredCreate initializes the interpreter.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "error.h"
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+#define MXTPU_DLL extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+struct Pred {
+  PyObject *obj = nullptr;            // mxnet_tpu.predict.Predictor
+  std::vector<mx_uint> shape_buf;     // MXPredGetOutputShape storage
+};
+
+std::mutex g_init_mu;
+
+void EnsurePython() {
+  // serialized: Py_InitializeEx is not thread-safe, and a second thread
+  // must not PyGILState_Ensure on a half-initialized interpreter
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // drop the init-acquired GIL; every entry point re-takes it via
+    // PyGILState_Ensure so calls work from any thread
+    PyEval_SaveThread();
+  }
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+std::string PyErrString() {
+  PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
+  PyErr_Fetch(&t, &v, &tb);
+  PyErr_NormalizeException(&t, &v, &tb);
+  std::string out = "python error";
+  if (v != nullptr) {
+    PyObject *s = PyObject_Str(v);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) out = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+  return out;
+}
+
+PyObject *Check(PyObject *o) {
+  if (o == nullptr) throw std::runtime_error(PyErrString());
+  return o;
+}
+
+/*! \brief owned reference: decrefs on every exit path (Check throws) */
+struct PyRef {
+  PyObject *p;
+  explicit PyRef(PyObject *o = nullptr) : p(o) {}
+  ~PyRef() { Py_XDECREF(p); }
+  PyObject *get() const { return p; }
+  PyObject *release() {
+    PyObject *r = p;
+    p = nullptr;
+    return r;
+  }
+  PyRef(const PyRef &) = delete;
+  PyRef &operator=(const PyRef &) = delete;
+};
+
+PyObject *Helper(const char *name) {
+  PyObject *mod = Check(PyImport_ImportModule("mxnet_tpu.predict"));
+  PyObject *fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  return Check(fn);
+}
+
+/* (keys, indptr, shape_data) CSR triple -> ([keys...], [shape tuples...]) */
+void ShapesFromCsr(mx_uint n, const char **keys, const mx_uint *indptr,
+                   const mx_uint *shape_data, PyObject **out_keys,
+                   PyObject **out_shapes) {
+  PyObject *k = Check(PyList_New(n));
+  PyObject *s = Check(PyList_New(n));
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(k, i, Check(PyUnicode_FromString(keys[i])));
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *shp = Check(PyTuple_New(hi - lo));
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo, Check(PyLong_FromUnsignedLong(shape_data[j])));
+    PyList_SET_ITEM(s, i, shp);
+  }
+  *out_keys = k;
+  *out_shapes = s;
+}
+
+}  // namespace
+
+MXTPU_DLL const char *MXGetLastError(void) { return mxtpu::GetLastError(); }
+
+MXTPU_DLL int MXPredCreatePartialOut(
+    const char *symbol_json_str, const void *param_bytes, int param_size,
+    int dev_type, int dev_id, mx_uint num_input_nodes,
+    const char **input_keys, const mx_uint *input_shape_indptr,
+    const mx_uint *input_shape_data, mx_uint num_output_nodes,
+    const char **output_keys, PredictorHandle *out) {
+  MXT_API_BEGIN();
+  EnsurePython();
+  Gil gil;
+  PyObject *k = nullptr, *s = nullptr;
+  ShapesFromCsr(num_input_nodes, input_keys, input_shape_indptr,
+                input_shape_data, &k, &s);
+  PyRef keys(k), shapes(s);
+  PyRef outs(Check(PyList_New(num_output_nodes)));
+  for (mx_uint i = 0; i < num_output_nodes; ++i)
+    PyList_SET_ITEM(outs.get(), i,
+                    Check(PyUnicode_FromString(output_keys[i])));
+  PyRef params(Check(PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size)));
+  PyRef fn(Helper("_c_create"));
+  PyRef pred(Check(PyObject_CallFunction(
+      fn.get(), "sOiiOOO", symbol_json_str, params.get(), dev_type, dev_id,
+      keys.get(), shapes.get(), outs.get())));
+  Pred *p = new Pred();
+  p->obj = pred.release();
+  *out = p;
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out) {
+  return MXPredCreatePartialOut(symbol_json_str, param_bytes, param_size,
+                                dev_type, dev_id, num_input_nodes,
+                                input_keys, input_shape_indptr,
+                                input_shape_data, 0, nullptr, out);
+}
+
+MXTPU_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Pred *p = static_cast<Pred *>(handle);
+  PyRef mv(Check(PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_READ)));
+  PyRef fn(Helper("_c_set_input"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "OsOI", p->obj, key,
+                                      mv.get(), size)));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredForward(PredictorHandle handle) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Pred *p = static_cast<Pred *>(handle);
+  PyRef r(Check(PyObject_CallMethod(p->obj, "forward", nullptr)));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left) {
+  /* the whole graph is ONE jitted XLA program here, so the partial
+   * schedule collapses to a single step (reference runs op-by-op) */
+  MXT_API_BEGIN();
+  if (step <= 0) {
+    int rc = MXPredForward(handle);
+    if (rc != 0) return rc;
+  }
+  *step_left = 0;
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data,
+                                   mx_uint *shape_ndim) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Pred *p = static_cast<Pred *>(handle);
+  PyRef fn(Helper("_c_output_shape"));
+  PyRef shp(Check(PyObject_CallFunction(fn.get(), "OI", p->obj, index)));
+  Py_ssize_t n = PyTuple_Size(shp.get());
+  p->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    p->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp.get(), i)));
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Pred *p = static_cast<Pred *>(handle);
+  PyRef fn(Helper("_c_get_output_bytes"));
+  PyRef b(Check(PyObject_CallFunction(fn.get(), "OI", p->obj, index)));
+  Py_ssize_t nbytes = PyBytes_Size(b.get());
+  if (nbytes != static_cast<Py_ssize_t>(size * sizeof(mx_float))) {
+    throw std::runtime_error("output size mismatch: have " +
+                             std::to_string(nbytes / sizeof(mx_float)) +
+                             " floats, caller asked " + std::to_string(size));
+  }
+  std::memcpy(data, PyBytes_AsString(b.get()), nbytes);
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            PredictorHandle handle, PredictorHandle *out) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Pred *p = static_cast<Pred *>(handle);
+  PyObject *k = nullptr, *s = nullptr;
+  ShapesFromCsr(num_input_nodes, input_keys, input_shape_indptr,
+                input_shape_data, &k, &s);
+  PyRef keys(k), shapes(s);
+  PyRef fn(Helper("_c_reshape"));
+  /* a NEW independent predictor sharing the loaded parameter arrays —
+   * the original handle keeps its shapes (reference semantics) */
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "OOO", p->obj, keys.get(),
+                                      shapes.get())));
+  Pred *np_ = new Pred();
+  np_->obj = r.release();
+  *out = np_;
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXPredFree(PredictorHandle handle) {
+  MXT_API_BEGIN();
+  Pred *p = static_cast<Pred *>(handle);
+  if (p != nullptr) {
+    if (Py_IsInitialized()) {
+      Gil gil;
+      Py_XDECREF(p->obj);
+    }
+    delete p;
+  }
+  MXT_API_END();
+}

@@ -1,0 +1,105 @@
+/* Reference-style C deployment client (reference
+ * example/image-classification/predict-cpp, amalgamation demos): load a
+ * symbol JSON + .params blob from disk, MXPredCreate, feed an input,
+ * forward, print the outputs.
+ *
+ * Usage: predict_demo <symbol.json> <model.params> <input_name> <n> <d>
+ * Reads n*d little-endian float32 values from stdin, prints each output
+ * row as space-separated floats on stdout (one line per sample).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+extern const char *MXGetLastError(void);
+extern int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        mx_uint num_input_nodes, const char **input_keys,
+                        const mx_uint *input_shape_indptr,
+                        const mx_uint *input_shape_data,
+                        PredictorHandle *out);
+extern int MXPredSetInput(PredictorHandle handle, const char *key,
+                          const mx_float *data, mx_uint size);
+extern int MXPredForward(PredictorHandle handle);
+extern int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                mx_uint **shape_data, mx_uint *shape_ndim);
+extern int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                           mx_float *data, mx_uint size);
+extern int MXPredFree(PredictorHandle handle);
+
+#define CHECK(call)                                                       \
+  do {                                                                    \
+    if ((call) != 0) {                                                    \
+      fprintf(stderr, "FAILED %s: %s\n", #call, MXGetLastError());        \
+      return 1;                                                           \
+    }                                                                     \
+  } while (0)
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return NULL; }
+  *size = ftell(f);
+  if (*size < 0 || fseek(f, 0, SEEK_SET) != 0) { fclose(f); return NULL; }
+  char *buf = (char *)malloc(*size + 1);
+  if (!buf || fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f); free(buf); return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s symbol.json model.params input_name n d\n",
+            argv[0]);
+    return 2;
+  }
+  long json_size = 0, param_size = 0;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!json || !params) {
+    fprintf(stderr, "cannot read model files\n");
+    return 1;
+  }
+  mx_uint n = (mx_uint)atoi(argv[4]), d = (mx_uint)atoi(argv[5]);
+
+  const char *input_keys[1] = {argv[3]};
+  mx_uint indptr[2] = {0, 2};
+  mx_uint shape[2];
+  shape[0] = n;
+  shape[1] = d;
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreate(json, params, (int)param_size, /*cpu*/ 1, 0, 1,
+                     input_keys, indptr, shape, &pred));
+
+  mx_float *in = (mx_float *)malloc(sizeof(mx_float) * n * d);
+  if (fread(in, sizeof(mx_float), n * d, stdin) != (size_t)(n * d)) {
+    fprintf(stderr, "short read on stdin\n");
+    return 1;
+  }
+  CHECK(MXPredSetInput(pred, argv[3], in, n * d));
+  CHECK(MXPredForward(pred));
+
+  mx_uint *oshape = NULL, ondim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  mx_uint total = 1;
+  for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
+  mx_float *out = (mx_float *)malloc(sizeof(mx_float) * total);
+  CHECK(MXPredGetOutput(pred, 0, out, total));
+
+  mx_uint cols = ondim > 1 ? total / oshape[0] : total;
+  for (mx_uint i = 0; i < total; ++i)
+    printf("%.6f%c", out[i], ((i + 1) % cols == 0) ? '\n' : ' ');
+
+  CHECK(MXPredFree(pred));
+  free(in);
+  free(out);
+  free(json);
+  free(params);
+  return 0;
+}

@@ -179,3 +179,59 @@ def test_ndlist_bf16_roundtrip(tmp_path):
     assert str(loaded.dtype) == "bfloat16"
     np.testing.assert_array_equal(loaded.asnumpy().astype(np.float32),
                                   np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_c_predict_api_end_to_end(tmp_path):
+    """Reference-style C deployment: export a trained symbol+params from
+    Python, run the compiled MXPred* client (src/tests/predict_demo.c)
+    against them, and check its outputs equal the Python Predictor's
+    (reference include/mxnet/c_predict_api.h flow)."""
+    import struct
+    import sys
+    import numpy as np
+    import mxnet_tpu as mx
+
+    build = subprocess.run(["make", "-C", SRC, "tests/predict_demo"],
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+
+    # tiny model: 2-layer MLP, deterministic params
+    rng = np.random.RandomState(0)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    params = {
+        "arg:fc1_weight": mx.nd.array(rng.randn(8, 5).astype(np.float32)),
+        "arg:fc1_bias": mx.nd.array(rng.randn(8).astype(np.float32)),
+        "arg:fc2_weight": mx.nd.array(rng.randn(3, 8).astype(np.float32)),
+        "arg:fc2_bias": mx.nd.array(rng.randn(3).astype(np.float32)),
+    }
+    sym_path = str(tmp_path / "model-symbol.json")
+    param_path = str(tmp_path / "model.params")
+    net.save(sym_path)
+    mx.nd.save(param_path, params)
+
+    x = rng.randn(4, 5).astype(np.float32)
+
+    from mxnet_tpu.predict import Predictor
+    with Predictor(open(sym_path).read(), param_path,
+                   input_shapes={"data": (4, 5)}) as pred:
+        pred.forward(data=x)
+        expect = pred.get_output(0)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.join(SRC, os.pardir)] + sys.path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    run = subprocess.run(
+        [os.path.join(SRC, "tests", "predict_demo"), sym_path, param_path,
+         "data", "4", "5"],
+        input=x.tobytes(), capture_output=True, env=env, timeout=420)
+    assert run.returncode == 0, run.stderr.decode()[-2000:]
+    got = np.array([[float(v) for v in line.split()]
+                    for line in run.stdout.decode().strip().splitlines()])
+    assert got.shape == expect.shape
+    assert np.allclose(got, expect, rtol=1e-4, atol=1e-5), (got, expect)
