@@ -13,11 +13,13 @@ from .ring_attention import (ring_attention, ulysses_attention,
                              local_attention, sequence_sharding)
 from .pipeline import pipeline_apply, stack_stage_params, PipelineTrainStep
 from .moe import moe_apply, stack_expert_params, MoETrainStep
+from .checkpoint import save_sharded, load_sharded, abstract_like
 
 __all__ = ["pipeline_apply", "stack_stage_params", "moe_apply", "stack_expert_params",
            "MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
            "dist", "DataParallelTrainStep", "ShardedTrainStep",
            "PipelineTrainStep", "MoETrainStep", "sgd_update",
            "split_and_load_sharded",
+           "save_sharded", "load_sharded", "abstract_like",
            "ring_attention", "ulysses_attention", "local_attention",
            "sequence_sharding"]

@@ -70,16 +70,26 @@ def bench_resnet50_train():
             "vs_baseline": round(v / BASELINES["resnet50_train"], 3)}
 
 
-def bench_lstm_ptb():
-    r, _ = _run([sys.executable, "examples/rnn/word_lm/benchmark.py"])
+def _bench_lstm(dtype):
+    r, _ = _run([sys.executable, "examples/rnn/word_lm/benchmark.py",
+                 "--dtype", dtype, "--num-calls", "8"])
     m = re.search(r"([\d.]+) tokens/s train", r.stdout)
     if not m:
         raise RuntimeError("lstm benchmark produced no rate:\n"
                            + r.stdout[-2000:] + r.stderr[-2000:])
     v = float(m.group(1))
-    return {"metric": "lstm_ptb_tokens_per_sec_bs32",
+    suffix = "" if dtype == "float32" else "_bf16"
+    return {"metric": "lstm_ptb_tokens_per_sec_bs32" + suffix,
             "value": v, "unit": "tokens/s",
             "vs_baseline": round(v / BASELINES["lstm_ptb"], 3)}
+
+
+def bench_lstm_ptb():
+    return _bench_lstm("float32")
+
+
+def bench_lstm_ptb_bf16():
+    return _bench_lstm("bfloat16")
 
 
 def _bench_sparse(name, script, examples, epochs, extra):
@@ -111,6 +121,7 @@ CONFIGS = {
     "resnet50_infer": bench_resnet50_infer,
     "resnet50_train": bench_resnet50_train,
     "lstm_ptb": bench_lstm_ptb,
+    "lstm_ptb_bf16": bench_lstm_ptb_bf16,
     "sparse_fm": bench_sparse_fm,
     "wide_deep": bench_wide_deep,
 }
