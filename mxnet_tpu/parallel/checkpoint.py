@@ -41,10 +41,11 @@ def save_sharded(path, tree, overwrite=True):
 
     # orbax's force= path handles the overwrite (primary-host-only removal
     # with a barrier) — a manual rmtree would race between hosts and
-    # destroy the old checkpoint before the new one is durable
-    ck = ocp.StandardCheckpointer()
-    ck.save(os.path.abspath(path), _unwrap(tree), force=overwrite)
-    ck.wait_until_finished()
+    # destroy the old checkpoint before the new one is durable. The
+    # context manager tears down the async-commit thread per call.
+    with ocp.StandardCheckpointer() as ck:
+        ck.save(os.path.abspath(path), _unwrap(tree), force=overwrite)
+        ck.wait_until_finished()
 
 
 def abstract_like(tree, shardings=None):
@@ -57,6 +58,8 @@ def abstract_like(tree, shardings=None):
     tree = _unwrap(tree)
 
     def one(v, s):
+        if not hasattr(v, "shape"):
+            return v  # scalar leaf (step counter, epoch): restore as-is
         s = s if s is not None else getattr(v, "sharding", None)
         return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=s)
 
@@ -74,5 +77,5 @@ def load_sharded(path, template):
     checkpoint may have been written from a different mesh."""
     import orbax.checkpoint as ocp
 
-    ck = ocp.StandardCheckpointer()
-    return ck.restore(os.path.abspath(path), template)
+    with ocp.StandardCheckpointer() as ck:
+        return ck.restore(os.path.abspath(path), template)

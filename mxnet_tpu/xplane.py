@@ -278,18 +278,30 @@ def op_table(logdir, line_filter=None, by="op", device_only=True):
                and any(l.events for l in p.lines)]
     host_fallback = device_only and not any("/device:" in p.name for p in dev)
     table = {}
-    for plane in dev if device_only else planes:
+    considered = dev if device_only else planes
+    # exact-name preference is GLOBAL: deciding per plane would let a
+    # plane lacking the exact line fall back to substring matching and
+    # mix async DMA spans into an otherwise compute-only table
+    exact = bool(line_filter) and any(
+        l.name == line_filter for p in considered for l in p.lines)
+    for plane in considered:
         # hierarchical lines overlap ('XLA Modules' events span their
         # 'XLA Ops' children): summing every line double-counts device
         # time.  With no explicit filter, restrict a device plane to its
         # per-op line when one exists.
+        # prefer EXACT line-name matches: the sync "XLA Ops" line is the
+        # serialized TensorCore timeline, while "Async XLA Ops" carries
+        # overlapping DMA spans — substring-matching both silently
+        # inflates the table with copy durations that overlap compute
         default_lines = None
         if not line_filter:
-            ops_lines = [l for l in plane.lines if "XLA Ops" in l.name]
+            ops_lines = [l for l in plane.lines if l.name == "XLA Ops"] \
+                or [l for l in plane.lines if "XLA Ops" in l.name]
             if ops_lines:
                 default_lines = {id(l) for l in ops_lines}
         for line in plane.lines:
-            if line_filter and line_filter not in line.name:
+            if line_filter and (line.name != line_filter if exact
+                                else line_filter not in line.name):
                 continue
             if default_lines is not None and id(line) not in default_lines:
                 continue

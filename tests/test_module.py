@@ -239,3 +239,51 @@ def test_python_loss_module():
         correct += (out[:n].argmax(1) == batch.label[0].asnumpy()[:n]).sum()
         total += n
     assert correct / total > 0.9, correct / total
+
+
+def test_step_scan_pack_small_matches_unpacked():
+    """Module.scan_pack_small (flat-packed rank<=1 carries) must produce
+    the same training trajectory as the plain scan."""
+    import numpy as np
+
+    def build():
+        data = mx.sym.var("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (6, 4))],
+                 label_shapes=[("softmax_label", (6,))])
+        mod.init_params(initializer=mx.init.Xavier(rnd_type="uniform"))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        return mod
+
+    rng = np.random.RandomState(5)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(6, 4).astype(np.float32))],
+        label=[mx.nd.array((rng.rand(6) * 3).astype(np.float32))])
+        for _ in range(4)]
+
+    ref = build()
+    packed = build()
+    a0, x0 = ref.get_params()  # same initial weights for both
+    packed.set_params(a0, x0)
+    out_ref = ref._step_scan(batches)
+    assert out_ref is not False
+    packed.scan_pack_small = True
+    out_pk = packed._step_scan(batches)
+    assert out_pk is not False
+    for a, b in zip(out_pk, out_ref):
+        assert np.allclose(a.asnumpy(), b.asnumpy(), rtol=1e-5, atol=1e-6)
+    a_ref, aux_ref = ref.get_params()
+    a_pk, aux_pk = packed.get_params()
+    for name in a_ref:
+        assert np.allclose(a_pk[name].asnumpy(), a_ref[name].asnumpy(),
+                           rtol=1e-5, atol=1e-6), name
+    for name in aux_ref:
+        assert np.allclose(aux_pk[name].asnumpy(), aux_ref[name].asnumpy(),
+                           rtol=1e-5, atol=1e-6), name
