@@ -275,3 +275,47 @@ def test_core_op_consistency_vs_cpu():
                 tol=5e-2, arg_params=arg_params)
         except AssertionError as e:
             raise AssertionError("%s: %s" % (name, e))
+
+
+def test_predict_api_on_chip():
+    """The predict path's accelerator mapping (c_predict_api dev_type=2 ->
+    mx.tpu()): create via the C-boundary helper, forward on the real
+    chip, outputs match a CPU predictor (reference c_predict_api.cc maps
+    dev_type 2 to GPU the same way)."""
+    ctx = _tpu_ctx()
+    assert ctx is not None
+    rng = np.random.RandomState(0)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    params = {
+        "arg:fc1_weight": mx.nd.array(rng.randn(8, 5).astype(np.float32)),
+        "arg:fc1_bias": mx.nd.array(rng.randn(8).astype(np.float32)),
+        "arg:fc2_weight": mx.nd.array(rng.randn(3, 8).astype(np.float32)),
+        "arg:fc2_bias": mx.nd.array(rng.randn(3).astype(np.float32)),
+    }
+    import tempfile, os as _os
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        path = f.name
+    mx.nd.save(path, params)
+    with open(path, "rb") as fh:
+        payload = fh.read()
+    _os.unlink(path)
+    x = rng.randn(4, 5).astype(np.float32)
+
+    from mxnet_tpu.predict import Predictor, _c_create
+    tpu_pred = _c_create(net.tojson(), payload, 2, 0, ["data"],
+                         [(4, 5)], [])
+    assert tpu_pred._ctx.device_type == "tpu"
+    tpu_pred.forward(data=x)
+    got = tpu_pred.get_output(0)
+
+    with Predictor(net.tojson(), payload, ctx=mx.cpu(),
+                   input_shapes={"data": (4, 5)}) as cpu_pred:
+        cpu_pred.forward(data=x)
+        expect = cpu_pred.get_output(0)
+    # bf16-precision MXU matmuls on chip vs f32 CPU: same tolerance as
+    # the other cpu-vs-tpu sweeps in this lane
+    np.testing.assert_allclose(got, expect, rtol=2e-2, atol=2e-3)
