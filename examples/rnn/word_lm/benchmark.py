@@ -99,7 +99,7 @@ def main():
     # best of 3 rounds: a single tunnel hiccup inside one short timed
     # window otherwise halves the reported rate (measured 131k vs 217k
     # tokens/s on back-to-back identical runs)
-    rate, last = 0.0, float("nan")
+    rates, last = [], float("nan")
     for _ in range(3):
         t0 = time.time()
         for _ in range(calls):
@@ -109,11 +109,14 @@ def main():
                 mod._step(batches[0])
         last = float(np.asarray(mod.get_outputs()[0].asnumpy()).ravel()[0])
         dt = time.time() - t0
-        rate = max(rate, calls * K * B * T / dt)
+        rates.append(calls * K * B * T / dt)
         assert np.isfinite(last)
+    rate = max(rates)
     print("PTB LSTM %dx%d vocab %d dtype %s batch %d seq %d: "
-          "%.0f tokens/s train via Module._step_scan (compile %.1fs)"
-          % (args.num_layers, H, V, args.dtype, B, T, rate, compile_s))
+          "%.0f tokens/s train via Module._step_scan "
+          "(best of %d rounds, mean %.0f; compile %.1fs)"
+          % (args.num_layers, H, V, args.dtype, B, T, rate,
+             len(rates), sum(rates) / len(rates), compile_s))
 
 
 if __name__ == "__main__":

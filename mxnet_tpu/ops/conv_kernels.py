@@ -1,0 +1,89 @@
+"""Pallas TPU kernel for the strided 1x1 convolution input gradient.
+
+Why this kernel exists (measured, round 4 → `docs/perf/
+resnet50_train_attribution.md`): the autodiff transpose of a stride-2
+1x1 conv is an lhs-dilated convolution — XLA's emitters compute it over
+the zero-injected (interleaved) input grid at 6-12 TF/s, 4x the useful
+MACs, and the pad(dy @ W^T) reformulation loses the saving again to a
+materialized intermediate (write dz + read dz + write dx instead of one
+dx write).  This kernel does the only two things the op actually needs —
+one compact MXU matmul `dy @ W^T` and one interleaved store — in a
+single pass: HBM traffic is read(dy) + read(W) + write(dx), FLOPs are
+the useful count, nothing else.
+
+Layout trick that makes the scatter free: for stride 2 the output
+`dx (N, H, W, C)` with `H = 2*Ho, W = 2*Wo` is byte-identical to
+`(N, Ho, 2, Wo, 2C)` (row-major).  In that view the nonzero positions
+(h, w both even) are exactly `[:, :, 0, :, 0:C]` — a static, lane-aligned
+slice (C is a multiple of 128 for every ResNet stage).  So the kernel
+zero-fills its VMEM output block and stores the matmul result into that
+slice; zero-filling costs VMEM stores only, the HBM write happens once
+per block either way.  The caller reshapes the result back — a bitcast.
+
+Reference parity: this replaces the backward half of
+`src/operator/nn/convolution-inl.h`'s 1x1 strided case (cuDNN dgrad in
+the reference); forward stays `lax.conv_general_dilated`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .pallas_kernels import _cast, _interpret
+
+__all__ = ["conv1x1_s2_dgrad"]
+
+
+def _kern(dy_ref, wt_ref, dx_ref):
+    dy = dy_ref[...]
+    bn, Ho, Wo, K = dy.shape
+    C = wt_ref.shape[1]
+    res = lax.dot_general(dy.reshape(bn * Ho * Wo, K), wt_ref[...],
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    dx_ref[...] = jnp.zeros(dx_ref.shape, dx_ref.dtype)
+    dx_ref[:, :, 0, :, 0:C] = _cast(res, dx_ref.dtype).reshape(bn, Ho, Wo, C)
+
+
+def _pick_bn(N, Ho, Wo, K, C, itemsize, budget=10 * 1024 * 1024):
+    """Largest batch block (divisor of N) whose dy + dx VMEM blocks fit."""
+    per_img = Ho * Wo * (K + 4 * C) * itemsize
+    bn = max(1, min(N, budget // max(per_img, 1)))
+    while N % bn:
+        bn -= 1
+    return bn
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def conv1x1_s2_dgrad(dy, w2, H, W):
+    """Input gradient of a stride-2, pad-0 NHWC 1x1 conv.
+
+    dy: (N, Ho, Wo, K) cotangent; w2: (K, C) kernel matrix (OHWI weight
+    reshaped); returns dx (N, H, W, C) with dx[:, ::2, ::2] = dy @ w2
+    and zeros elsewhere.  Requires H == 2*Ho, W == 2*Wo (every strided
+    1x1 in the ResNet zoo satisfies this; callers fall back to XLA's
+    conv transpose otherwise).
+    """
+    N, Ho, Wo, K = dy.shape
+    C = w2.shape[1]
+    if H != 2 * Ho or W != 2 * Wo:
+        raise ValueError("conv1x1_s2_dgrad needs H==2*Ho, W==2*Wo; got "
+                         "H=%d Ho=%d W=%d Wo=%d" % (H, Ho, W, Wo))
+    bn = _pick_bn(N, Ho, Wo, K, C, dy.dtype.itemsize)
+    out = pl.pallas_call(
+        _kern,
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, Ho, Wo, K), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((K, C), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, Ho, 2, Wo, 2 * C),
+                               lambda i: (i, 0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, Ho, 2, Wo, 2 * C), dy.dtype),
+        interpret=_interpret(),
+    )(dy, w2)
+    return out.reshape(N, H, W, C)

@@ -263,6 +263,127 @@ def _conv1x1_strided_fn(stride, dspec, wspec, caxis, dshape):
     return f
 
 
+def _env_on(name, default="0"):
+    """Boolean env gate: '0'/''/'false'/'off'/'no' (any case) disable."""
+    return os.environ.get(name, default).lower() not in (
+        "0", "", "false", "off", "no")
+
+
+def _env_int(name, default=0):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _plain_1x1(kernel, pad, dilate, groups):
+    """Pointwise conv: 1x1 kernel, no padding/dilation/groups."""
+    return (set(kernel) == {1} and set(pad) == {0} and set(dilate) == {1}
+            and groups == 1)
+
+
+def _pointwise_conv_fwd(dspec, wspec, stride):
+    """Forward lowering shared by every custom-VJP 1x1 path: the plain
+    conv_general_dilated (XLA's emitters win on fwd epilogue fusion)."""
+    nd = len(stride)
+
+    def conv_fwd(data, weight):
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        (dspec, wspec, dspec))
+        return lax.conv_general_dilated(
+            data, weight, window_strides=stride,
+            padding=[(0, 0)] * nd, dimension_numbers=dn)
+    return conv_fwd
+
+
+@_functools.lru_cache(maxsize=None)
+def _conv1x1_pallas_fn(stride, dspec, wspec, dshape):
+    """NHWC stride-2 1x1 conv whose input gradient is the Pallas
+    matmul+interleave kernel (`conv_kernels.conv1x1_s2_dgrad`).
+
+    Forward stays `lax.conv_general_dilated` (healthy, ~130 TF/s).  The
+    default dgrad is XLA's lhs-dilated conv emitter at 6-12 TF/s on the
+    ResNet stage-entry shapes; the Pallas kernel does the compact matmul
+    and writes the zero-interleaved dx in one pass.  wgrad becomes one
+    f32-accumulated MXU matmul over the strided input slice (the only
+    residual kept).  Gate: MXNET_CONV1X1_PALLAS (see _convolution).
+    """
+    conv_fwd = _pointwise_conv_fwd(dspec, wspec, stride)
+    f = jax.custom_vjp(conv_fwd)
+
+    def fwd_rule(data, weight):
+        xs = data[:, ::stride[0], ::stride[1], :]
+        return conv_fwd(data, weight), (xs, weight)
+
+    def bwd_rule(res, dy):
+        from .conv_kernels import conv1x1_s2_dgrad
+        xs, weight = res
+        w2 = weight.reshape(weight.shape[0], -1)        # (O, C) for OHWI
+        dx = conv1x1_s2_dgrad(dy, w2, dshape[1], dshape[2])
+        dw = lax.dot_general(dy, xs, (((0, 1, 2), (0, 1, 2)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        return dx, dw.reshape(weight.shape).astype(weight.dtype)
+
+    f.defvjp(fwd_rule, bwd_rule)
+    return f
+
+
+def _conv1x1_pallas_wanted(kernel, stride, pad, dilate, groups, caxis, nd,
+                           dshape):
+    if not _env_on("MXNET_CONV1X1_PALLAS"):
+        return False
+    if (not _plain_1x1(kernel, pad, dilate, groups)
+            or nd != 2 or caxis != nd + 1):
+        return False
+    if stride != (2, 2):
+        return False
+    # kernel needs the exact 2x interleave view (H==2*Ho) and a
+    # lane-aligned channel count
+    return (dshape[1] % 2 == 0 and dshape[2] % 2 == 0
+            and dshape[3] % 128 == 0)
+
+
+@_functools.lru_cache(maxsize=None)
+def _conv1x1_s1_dot_bwd_fn(dspec, wspec):
+    """NHWC stride-1 1x1 conv with dot_general gradients (fwd unchanged).
+
+    XLA's conv TRANSPOSE emitter picks batch-in-sublanes layouts for the
+    56x56-stage 64-channel dgrads (10-23 TF/s measured); expressing the
+    same contraction as an explicit dot keeps it a plain MXU matmul.
+    Gate: MXNET_CONV1X1_S1DOT=<min-channel threshold> (see _convolution).
+    """
+    conv_fwd = _pointwise_conv_fwd(dspec, wspec, (1, 1))
+    f = jax.custom_vjp(conv_fwd)
+
+    def fwd_rule(data, weight):
+        return conv_fwd(data, weight), (data, weight)
+
+    def bwd_rule(res, dy):
+        x, weight = res
+        w2 = weight.reshape(weight.shape[0], -1)        # (O, C)
+        dx = lax.dot_general(dy, w2, (((3,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        dw = lax.dot_general(dy, x, (((0, 1, 2), (0, 1, 2)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        return dx.astype(x.dtype), dw.reshape(weight.shape).astype(weight.dtype)
+
+    f.defvjp(fwd_rule, bwd_rule)
+    return f
+
+
+def _conv1x1_s1_dot_wanted(kernel, stride, pad, dilate, groups, caxis, nd,
+                           weight):
+    thresh = _env_int("MXNET_CONV1X1_S1DOT")
+    if thresh <= 0:
+        return False
+    if (not _plain_1x1(kernel, pad, dilate, groups)
+            or nd != 2 or caxis != nd + 1):
+        return False
+    if stride != (1, 1):
+        return False
+    return min(weight.shape[0], weight.shape[-1]) <= thresh
+
+
 @register("Convolution")
 def _convolution(params, data, weight, *bias):
     kernel = tuple(params["kernel"])
@@ -275,13 +396,18 @@ def _convolution(params, data, weight, *bias):
     if _s2d_eligible(params, data, weight, kernel, stride, dilate, groups,
                      caxis):
         out = _space_to_depth_conv(data, weight, pad)
-    elif (set(kernel) == {1} and set(pad) == {0} and set(dilate) == {1}
-          and groups == 1
+    elif (_plain_1x1(kernel, pad, dilate, groups)
           and _conv1x1_dot_wanted(stride)):
         out = _conv1x1_as_dot(data, weight, stride, caxis)
-    elif (set(kernel) == {1} and set(pad) == {0} and set(dilate) == {1}
-          and groups == 1 and max(stride) > 1
-          and os.environ.get("MXNET_CONV1X1_BWD", "0") == "1"):
+    elif _conv1x1_pallas_wanted(kernel, stride, pad, dilate, groups, caxis,
+                                nd, data.shape):
+        out = _conv1x1_pallas_fn(stride, dspec, wspec,
+                                 data.shape)(data, weight)
+    elif _conv1x1_s1_dot_wanted(kernel, stride, pad, dilate, groups, caxis,
+                                nd, weight):
+        out = _conv1x1_s1_dot_bwd_fn(dspec, wspec)(data, weight)
+    elif (_plain_1x1(kernel, pad, dilate, groups) and max(stride) > 1
+          and _env_on("MXNET_CONV1X1_BWD")):
         out = _conv1x1_strided_fn(stride, dspec, wspec, caxis,
                                   data.shape)(data, weight)
     else:

@@ -99,6 +99,7 @@ class Predictor:
         return params
 
     def _bind(self):
+        self._settable = None  # _input_names() cache: recompute per bind
         shapes = dict(self._input_shapes)
         for name in self._symbol.list_arguments():
             if name in self._params and name not in shapes:
@@ -112,11 +113,26 @@ class Predictor:
         self._exec = ex
 
     # ------------------------------------------------------------------
+    def _input_names(self):
+        """Settable keys: declared input shapes plus any argument the
+        loaded params did NOT provide. Weights are NOT settable — the
+        reference c_predict_api rejects non-input keys, so a mistyped
+        key errors instead of silently overwriting a weight (ADVICE r4).
+        Cached per bind (invariant until reshape(), which rebinds).
+        """
+        names = getattr(self, "_settable", None)
+        if names is None:
+            names = set(self._input_shapes) | {
+                n for n in self._symbol.list_arguments()
+                if n not in self._params}
+            self._settable = names
+        return names
+
     def set_input(self, name, data):
         """MXPredSetInput: copy host data into the named input."""
-        if name not in self._exec.arg_dict:
-            raise MXNetError("no input named %r; arguments are %s"
-                             % (name, self._symbol.list_arguments()))
+        if name not in self._exec.arg_dict or name not in self._input_names():
+            raise MXNetError("no input named %r; inputs are %s"
+                             % (name, sorted(self._input_names())))
         data = np.asarray(data, dtype=self._exec.arg_dict[name].dtype)
         if tuple(data.shape) != self._exec.arg_dict[name].shape:
             raise MXNetError(
@@ -195,8 +211,9 @@ def _c_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
 def _c_set_input(pred, key, memview, size):
     arr = np.frombuffer(memview, dtype=np.float32, count=int(size))
     bound = pred._exec.arg_dict.get(key)
-    if bound is None:
-        raise MXNetError("no input named %r" % key)
+    if bound is None or key not in pred._input_names():
+        raise MXNetError("no input named %r; inputs are %s"
+                         % (key, sorted(pred._input_names())))
     if int(size) != int(np.prod(bound.shape)):
         raise MXNetError("input %r size %d != bound size %d"
                          % (key, int(size), int(np.prod(bound.shape))))

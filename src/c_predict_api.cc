@@ -213,9 +213,15 @@ MXTPU_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
   PyRef shp(Check(PyObject_CallFunction(fn.get(), "OI", p->obj, index)));
   Py_ssize_t n = PyTuple_Size(shp.get());
   p->shape_buf.resize(n);
-  for (Py_ssize_t i = 0; i < n; ++i)
-    p->shape_buf[i] = static_cast<mx_uint>(
-        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp.get(), i)));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    unsigned long v = PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp.get(), i));
+    if (v == static_cast<unsigned long>(-1) && PyErr_Occurred()) {
+      PyErr_Clear();
+      throw std::runtime_error("output shape dim " + std::to_string(i) +
+                               " is not an unsigned integer");
+    }
+    p->shape_buf[i] = static_cast<mx_uint>(v);
+  }
   *shape_data = p->shape_buf.data();
   *shape_ndim = static_cast<mx_uint>(n);
   MXT_API_END();

@@ -66,7 +66,16 @@ int main(int argc, char **argv) {
     fprintf(stderr, "cannot read model files\n");
     return 1;
   }
-  mx_uint n = (mx_uint)atoi(argv[4]), d = (mx_uint)atoi(argv[5]);
+  char *end_n = NULL, *end_d = NULL;
+  unsigned long ln = strtoul(argv[4], &end_n, 10);
+  unsigned long ld = strtoul(argv[5], &end_d, 10);
+  if (!end_n || *end_n || !end_d || *end_d || ln == 0 || ld == 0 ||
+      ln > 0xffffffffUL || ld > 0xffffffffUL ||
+      ln > 0xffffffffUL / ld /* n*d must fit the uint math below */) {
+    fprintf(stderr, "bad batch/dim arguments: %s %s\n", argv[4], argv[5]);
+    return 2;
+  }
+  mx_uint n = (mx_uint)ln, d = (mx_uint)ld;
 
   const char *input_keys[1] = {argv[3]};
   mx_uint indptr[2] = {0, 2};
@@ -78,6 +87,10 @@ int main(int argc, char **argv) {
                      input_keys, indptr, shape, &pred));
 
   mx_float *in = (mx_float *)malloc(sizeof(mx_float) * n * d);
+  if (!in) {
+    fprintf(stderr, "out of memory for %u x %u input\n", n, d);
+    return 1;
+  }
   if (fread(in, sizeof(mx_float), n * d, stdin) != (size_t)(n * d)) {
     fprintf(stderr, "short read on stdin\n");
     return 1;
@@ -90,6 +103,10 @@ int main(int argc, char **argv) {
   mx_uint total = 1;
   for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
   mx_float *out = (mx_float *)malloc(sizeof(mx_float) * total);
+  if (!out) {
+    fprintf(stderr, "out of memory for %u outputs\n", total);
+    return 1;
+  }
   CHECK(MXPredGetOutput(pred, 0, out, total));
 
   mx_uint cols = ondim > 1 ? total / oshape[0] : total;

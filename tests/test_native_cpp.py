@@ -235,3 +235,20 @@ def test_c_predict_api_end_to_end(tmp_path):
                     for line in run.stdout.decode().strip().splitlines()])
     assert got.shape == expect.shape
     assert np.allclose(got, expect, rtol=1e-4, atol=1e-5), (got, expect)
+
+    # ADVICE r4: a weight name must NOT be settable through set_input —
+    # the reference c_predict_api rejects keys that aren't declared
+    # inputs (a typo would otherwise silently overwrite the weight)
+    import pytest
+    with Predictor(open(sym_path).read(), param_path,
+                   input_shapes={"data": (4, 5)}) as pred:
+        with pytest.raises(mx.base.MXNetError, match="no input named"):
+            pred.set_input("fc1_weight", np.zeros((8, 5), np.float32))
+
+    # bad CLI arguments must error out, not crash (ADVICE r4)
+    bad = subprocess.run(
+        [os.path.join(SRC, "tests", "predict_demo"), sym_path, param_path,
+         "data", "0", "xyz"],
+        input=b"", capture_output=True, env=env, timeout=60)
+    assert bad.returncode == 2
+    assert b"bad batch/dim" in bad.stderr

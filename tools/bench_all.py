@@ -35,7 +35,12 @@ BASELINES = {
     "resnet50_infer": 109.0,       # K80 img/s (BASELINE.md)
     "resnet50_train": 2900.0,      # A100-class img/s/chip target
     "lstm_ptb": 14400.0,           # reference 4x K80 tokens/s word_lm
-    "lstm_ptb_bf16": 87104.0,      # round-3 recorded bf16 = regression floor
+    # Round-3 recorded bf16 = regression floor. Config note (ADVICE r4):
+    # recorded BEFORE round 4 added elementwise clip_gradient=0.25 to the
+    # measured update path (the reference recipe clips global norm); the
+    # clipped config re-measured 405k tokens/s, so the floor is
+    # conservative and ratios vs it remain meaningful.
+    "lstm_ptb_bf16": 87104.0,
     "sparse_fm": None,
     "wide_deep": None,
 }
@@ -49,9 +54,16 @@ def _run(cmd, timeout=3600):
 
 
 def bench_resnet50_infer():
-    r, _ = _run([sys.executable, "bench.py"])
-    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
-    return json.loads(line)
+    # --infer-only: bench.py's full run now appends the TRAIN line last
+    # (the driver's north-star record); this config wants just inference
+    r, _ = _run([sys.executable, "bench.py", "--infer-only"])
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    for rec in lines:
+        if rec.get("metric") == "resnet50_infer_imgs_per_sec_bs32":
+            return rec
+    raise RuntimeError("bench.py produced no inference record:\n"
+                       + r.stdout[-2000:] + r.stderr[-2000:])
 
 
 def bench_resnet50_train():

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Per-step conv/op attribution from an XPlane trace: joins each XLA-Ops
+event's metadata (flops, bytes_accessed, output shape, jax tf_op path)
+into a per-step table with achieved TF/s and GB/s — the instrument behind
+docs/perf/resnet50_train_attribution.md, automated (round 4 did this join
+by hand against the compiled HLO).
+
+Usage:
+    python tools/perf/trace_attr.py TRACE_DIR --steps 150 [--top 40]
+            [--filter conv] [--json out.json]
+
+--steps: total train steps the trace covers (calls x batches-per-dispatch);
+per-step ms = sum over an op's unroll siblings / steps.  Ops are grouped by
+(tf_op, output shape): unroll copies of the same logical op land together.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mxnet_tpu.xplane import find_xplane_files, parse_xspace
+
+
+def collect(logdir, line_name="XLA Ops"):
+    rows = []
+    for path in find_xplane_files(logdir):
+        for plane in parse_xspace(path):
+            if "TPU" not in plane.name and "Device" not in plane.name:
+                continue
+            for line in plane.lines:
+                if line.name != line_name:
+                    continue
+                for ev in line.events:
+                    rows.append(ev)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir")
+    ap.add_argument("--steps", type=int, required=True,
+                    help="total train steps covered by the trace")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--filter", default=None,
+                    help="substring filter on the tf_op path")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    groups = collections.defaultdict(
+        lambda: {"ms": 0.0, "count": 0, "flops": 0, "bytes": 0,
+                 "names": set(), "source": ""})
+    for ev in collect(args.logdir):
+        if ev.name.startswith("while"):
+            continue  # container; its body ops are separate events
+        st = ev.stats
+        tf_op = str(st.get("tf_op", ev.name))
+        shape = str(st.get("shape_with_layout", ""))
+        shape = re.sub(r"\{[^}]*\}", "", shape)     # drop layout annotations
+        key = (tf_op, shape)
+        g = groups[key]
+        g["ms"] += ev.duration_ps / 1e9
+        g["count"] += 1
+        g["flops"] += int(st.get("flops", 0) or 0)
+        g["bytes"] += int(st.get("bytes_accessed", 0) or 0)
+        g["names"].add(re.sub(r"\.\d+$", "", ev.name))
+        g["source"] = str(st.get("source", ""))
+
+    rows = []
+    for (tf_op, shape), g in groups.items():
+        if args.filter and args.filter not in tf_op:
+            continue
+        ms_step = g["ms"] / args.steps
+        sec = g["ms"] / 1e3
+        rows.append({
+            "tf_op": tf_op.split("/")[-1].rstrip(":"),
+            "path": tf_op,
+            "shape": shape,
+            "fusion": "+".join(sorted(g["names"])),
+            "ms_per_step": round(ms_step, 3),
+            "tf_s": round(g["flops"] / sec / 1e12, 1) if sec else 0.0,
+            "gb_s": round(g["bytes"] / sec / 1e9, 0) if sec else 0.0,
+            "count": g["count"],
+        })
+    rows.sort(key=lambda r: -r["ms_per_step"])
+
+    total = sum(r["ms_per_step"] for r in rows)
+    hdr = "%-34s %-36s %9s %7s %7s" % ("op", "out shape", "ms/step",
+                                       "TF/s", "GB/s")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows[:args.top]:
+        name = ("bwd:" if "transpose(jvp" in r["path"] else "") + r["tf_op"]
+        print("%-34s %-36s %9.3f %7.1f %7.0f"
+              % (name[:34], r["shape"][:36], r["ms_per_step"],
+                 r["tf_s"], r["gb_s"]))
+    print("-" * len(hdr))
+    print("%-34s %45.3f ms/step over %d rows" % ("TOTAL (excl. while)",
+                                                 total, len(rows)))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
