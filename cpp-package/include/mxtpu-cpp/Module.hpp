@@ -1,0 +1,179 @@
+/*!
+ * \file Module.hpp
+ * \brief Header-only C++ RAII wrapper over the MXT* TRAIN ABI
+ * (libmxtpu_predict.so, src/c_train_api.cc).
+ *
+ * The analog of the reference cpp-package's TRAINING path
+ * (cpp-package/include/mxnet-cpp/MxNetCpp.h + example/lenet.cpp: build a
+ * symbol, bind an executor, step an optimizer from C++): symbol JSON ->
+ * bind(data+label shapes) -> InitParams -> InitOptimizer -> Step(batch)
+ * in a loop -> read outputs / save a checkpoint. Behind the C boundary
+ * each Step runs the SAME fused forward/backward/update XLA program
+ * Python's Module.fit dispatches.
+ *
+ * Link: -lmxtpu_predict (build with `make -C src predict`). The host
+ * process must expose a PYTHONPATH resolving mxnet_tpu and jax — the
+ * ABI embeds CPython (see c_train_api.cc header comment).
+ */
+#ifndef MXTPU_CPP_MODULE_HPP_
+#define MXTPU_CPP_MODULE_HPP_
+
+#include <cstddef>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *ModuleHandle;
+const char *MXGetLastError(void);
+int MXTModuleCreate(const char *symbol_json, int dev_type, int dev_id,
+                    mx_uint num_data, const char **data_keys,
+                    mx_uint num_label, const char **label_keys,
+                    ModuleHandle *out);
+int MXTModuleBind(ModuleHandle handle, mx_uint num_inputs,
+                  const char **input_keys, const mx_uint *shape_indptr,
+                  const mx_uint *shape_data);
+int MXTModuleInitParams(ModuleHandle handle, const char *initializer,
+                        int seed);
+int MXTModuleInitOptimizer(ModuleHandle handle, const char *name,
+                           mx_uint num_params, const char **keys,
+                           const char **vals);
+int MXTModuleStep(ModuleHandle handle, mx_uint num_inputs,
+                  const char **input_keys, const mx_float **buffers,
+                  const mx_uint *sizes);
+int MXTModuleForward(ModuleHandle handle, mx_uint num_inputs,
+                     const char **input_keys, const mx_float **buffers,
+                     const mx_uint *sizes);
+int MXTModuleGetOutputShape(ModuleHandle handle, mx_uint index,
+                            mx_uint **shape_data, mx_uint *shape_ndim);
+int MXTModuleGetOutput(ModuleHandle handle, mx_uint index, mx_float *data,
+                       mx_uint size);
+int MXTModuleSaveCheckpoint(ModuleHandle handle, const char *prefix,
+                            int epoch);
+int MXTModuleLoadParams(ModuleHandle handle, const char *path);
+int MXTModuleFree(ModuleHandle handle);
+}
+
+namespace mxtpu {
+namespace cpp {
+
+/*! \brief one named float32 host buffer fed to Step/Forward */
+struct NamedBuffer {
+  std::string name;
+  const mx_float *data;
+  mx_uint size;
+};
+
+class Module {
+ public:
+  /*! \param dev_type 1 = cpu, 2 = accelerator (TPU) */
+  Module(const std::string &symbol_json,
+         const std::vector<std::string> &data_names,
+         const std::vector<std::string> &label_names, int dev_type = 2,
+         int dev_id = 0) {
+    std::vector<const char *> dk, lk;
+    for (const auto &n : data_names) dk.push_back(n.c_str());
+    for (const auto &n : label_names) lk.push_back(n.c_str());
+    CheckRc(MXTModuleCreate(symbol_json.c_str(), dev_type, dev_id,
+                            static_cast<mx_uint>(dk.size()), dk.data(),
+                            static_cast<mx_uint>(lk.size()), lk.data(),
+                            &handle_));
+  }
+
+  ~Module() {
+    if (handle_ != nullptr) MXTModuleFree(handle_);
+  }
+  Module(const Module &) = delete;
+  Module &operator=(const Module &) = delete;
+
+  void Bind(const std::map<std::string, std::vector<mx_uint>> &shapes) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr(1, 0), flat;
+    for (const auto &kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      flat.insert(flat.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(flat.size()));
+    }
+    CheckRc(MXTModuleBind(handle_, static_cast<mx_uint>(keys.size()),
+                          keys.data(), indptr.data(), flat.data()));
+  }
+
+  void InitParams(const std::string &initializer = "xavier", int seed = 0) {
+    CheckRc(MXTModuleInitParams(handle_, initializer.c_str(), seed));
+  }
+
+  void InitOptimizer(const std::string &name,
+                     const std::map<std::string, std::string> &params) {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    CheckRc(MXTModuleInitOptimizer(handle_, name.c_str(),
+                                   static_cast<mx_uint>(keys.size()),
+                                   keys.data(), vals.data()));
+  }
+
+  /*! \brief one fused forward/backward/optimizer-update step */
+  void Step(const std::vector<NamedBuffer> &inputs) {
+    Feed(&MXTModuleStep, inputs);
+  }
+
+  /*! \brief inference forward (no gradient, no update) */
+  void Forward(const std::vector<NamedBuffer> &inputs) {
+    Feed(&MXTModuleForward, inputs);
+  }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) {
+    mx_uint *data = nullptr, ndim = 0;
+    CheckRc(MXTModuleGetOutputShape(handle_, index, &data, &ndim));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index = 0) {
+    std::vector<mx_uint> shape = GetOutputShape(index);
+    mx_uint total = 1;
+    for (mx_uint d : shape) total *= d;
+    std::vector<mx_float> out(total);
+    CheckRc(MXTModuleGetOutput(handle_, index, out.data(), total));
+    return out;
+  }
+
+  void SaveCheckpoint(const std::string &prefix, int epoch) {
+    CheckRc(MXTModuleSaveCheckpoint(handle_, prefix.c_str(), epoch));
+  }
+
+  void LoadParams(const std::string &path) {
+    CheckRc(MXTModuleLoadParams(handle_, path.c_str()));
+  }
+
+ private:
+  template <typename Fn>
+  void Feed(Fn fn, const std::vector<NamedBuffer> &inputs) {
+    std::vector<const char *> keys;
+    std::vector<const mx_float *> bufs;
+    std::vector<mx_uint> sizes;
+    for (const auto &b : inputs) {
+      keys.push_back(b.name.c_str());
+      bufs.push_back(b.data);
+      sizes.push_back(b.size);
+    }
+    CheckRc(fn(handle_, static_cast<mx_uint>(keys.size()), keys.data(),
+               bufs.data(), sizes.data()));
+  }
+
+  static void CheckRc(int rc) {
+    if (rc != 0) throw std::runtime_error(MXGetLastError());
+  }
+
+  ModuleHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_MODULE_HPP_

@@ -1,0 +1,184 @@
+"""Python-side runtime for the MXT* TRAIN C ABI (src/c_train_api.cc).
+
+The reference's cpp-package trains real models from C++ over the 183-fn
+`include/mxnet/c_api.h` (NDArray/Symbol/Executor/Optimizer/KVStore); this
+framework's native train surface keeps the same layering with a far
+smaller ABI: the C library embeds CPython and delegates to this module,
+which drives the SAME `mxnet_tpu.module.Module` path Python training
+uses — so a C++ host process gets the identical fused
+forward/backward/update XLA program, not a parallel implementation.
+
+Every `_c_*` helper takes/returns only simple types (str, int, bytes,
+tuples) so the C side stays generic `PyObject_CallFunction` calls —
+mirroring mxnet_tpu/predict.py's `_c_*` predict helpers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+class CTrainer:
+    """A bound, steppable training module for the C ABI.
+
+    Wraps `mx.mod.Module` (reference module/module.py semantics): symbol
+    from JSON -> bind(data+label shapes) -> init_params ->
+    init_optimizer -> step(batch) repeatedly; outputs/params readable
+    back as raw float32 buffers.
+    """
+
+    def __init__(self, symbol_json, dev_type, dev_id, data_names,
+                 label_names):
+        from . import context, mod as _mod, sym as _sym
+        if dev_type == 2 and context.num_tpus():
+            ctx = context.tpu(dev_id)
+        else:
+            ctx = context.cpu(dev_id)
+        self._ctx = ctx
+        self._symbol = _sym.load_json(symbol_json)
+        self._module = _mod.Module(self._symbol,
+                                   data_names=list(data_names),
+                                   label_names=list(label_names),
+                                   context=ctx)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
+        self._shapes = {}
+
+    def bind(self, names, shapes):
+        self._shapes = {n: tuple(int(d) for d in s)
+                        for n, s in zip(names, shapes)}
+        missing = [n for n in self._data_names + self._label_names
+                   if n not in self._shapes]
+        if missing:
+            raise MXNetError("bind: missing shapes for %s" % missing)
+        self._module.bind(
+            data_shapes=[(n, self._shapes[n]) for n in self._data_names],
+            label_shapes=[(n, self._shapes[n]) for n in self._label_names])
+
+    def init_params(self, initializer="xavier", seed=0):
+        from . import init as _init, random as _random
+        _random.seed(seed)
+        # initializers draw from the global numpy RNG (initializer.py),
+        # which mx.random.seed does not touch — seed it too so a C host
+        # gets identical params for identical (initializer, seed)
+        np.random.seed(seed)
+        table = {"xavier": _init.Xavier(),
+                 "uniform": _init.Uniform(0.07),
+                 "normal": _init.Normal(0.01),
+                 "zeros": _init.Zero(),
+                 "msra": _init.MSRAPrelu()}
+        if initializer not in table:
+            raise MXNetError("unknown initializer %r (have %s)"
+                             % (initializer, sorted(table)))
+        self._module.init_params(initializer=table[initializer])
+
+    def init_optimizer(self, name, params):
+        kwargs = {}
+        for k, v in params.items():
+            try:
+                kwargs[k] = float(v)
+            except ValueError:
+                kwargs[k] = v
+        self._module.init_optimizer(optimizer=name,
+                                    optimizer_params=kwargs)
+
+    def step(self, names, buffers):
+        """One fused forward/backward/optimizer step on host buffers."""
+        from .io import DataBatch
+        from . import nd
+        arrs = {}
+        for n, buf in zip(names, buffers):
+            shape = self._shapes.get(n)
+            if shape is None:
+                raise MXNetError("step: %r was not bound" % n)
+            a = np.frombuffer(buf, dtype=np.float32,
+                              count=int(np.prod(shape))).reshape(shape)
+            arrs[n] = nd.array(a, ctx=self._ctx)
+        batch = DataBatch(data=[arrs[n] for n in self._data_names],
+                          label=[arrs[n] for n in self._label_names])
+        self._module._step(batch)
+
+    def forward(self, names, buffers):
+        """Inference-mode forward (is_train=False) on host buffers."""
+        from .io import DataBatch
+        from . import nd
+        arrs = {}
+        for n, buf in zip(names, buffers):
+            shape = self._shapes[n]
+            a = np.frombuffer(buf, dtype=np.float32,
+                              count=int(np.prod(shape))).reshape(shape)
+            arrs[n] = nd.array(a, ctx=self._ctx)
+        batch = DataBatch(data=[arrs[n] for n in self._data_names],
+                          label=None)
+        self._module.forward(batch, is_train=False)
+
+    def num_outputs(self):
+        return len(self._module.get_outputs())
+
+    def output_shape(self, index):
+        return tuple(int(d)
+                     for d in self._module.get_outputs()[index].shape)
+
+    def output_bytes(self, index):
+        out = self._module.get_outputs()[index].asnumpy()
+        return np.ascontiguousarray(out, dtype=np.float32).tobytes()
+
+    def save_checkpoint(self, prefix, epoch):
+        self._module.save_checkpoint(prefix, int(epoch))
+
+    def load_params(self, path):
+        from . import nd
+        loaded = nd.load(path)
+        arg, aux = {}, {}
+        for k, v in loaded.items():
+            if k.startswith("aux:"):
+                aux[k[4:]] = v
+            else:
+                arg[k.split(":", 1)[-1]] = v
+        self._module.set_params(arg, aux, allow_missing=False)
+
+
+# ---------------------------------------------------------------------------
+# C-boundary helpers (src/c_train_api.cc) — simple-typed, mirror
+# predict.py's _c_* layer.
+# ---------------------------------------------------------------------------
+def _c_create(symbol_json, dev_type, dev_id, data_names, label_names):
+    return CTrainer(symbol_json, int(dev_type), int(dev_id),
+                    list(data_names), list(label_names))
+
+
+def _c_bind(tr, names, shapes):
+    tr.bind(list(names), [tuple(s) for s in shapes])
+
+
+def _c_init_params(tr, initializer, seed):
+    tr.init_params(initializer, int(seed))
+
+
+def _c_init_optimizer(tr, name, keys, vals):
+    tr.init_optimizer(name, dict(zip(keys, vals)))
+
+
+def _c_step(tr, names, memviews):
+    tr.step(list(names), list(memviews))
+
+
+def _c_forward(tr, names, memviews):
+    tr.forward(list(names), list(memviews))
+
+
+def _c_output_shape(tr, index):
+    return tr.output_shape(int(index))
+
+
+def _c_output_bytes(tr, index):
+    return tr.output_bytes(int(index))
+
+
+def _c_save_checkpoint(tr, prefix, epoch):
+    tr.save_checkpoint(prefix, epoch)
+
+
+def _c_load_params(tr, path):
+    tr.load_params(path)

@@ -90,13 +90,14 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, ctx=cpu(), **kwargs):
+def get_densenet(num_layers, pretrained=False, ctx=cpu(), root=None,
+                 **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a converted "
-            ".params file with net.load_params instead")
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("densenet%d" % num_layers,
+                                           root=root), ctx=ctx)
     return net
 
 

@@ -150,10 +150,10 @@ class Inception3(HybridBlock):
 
 
 
-def inception_v3(pretrained=False, ctx=cpu(), **kwargs):
+def inception_v3(pretrained=False, ctx=cpu(), root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a converted "
-            ".params file with net.load_params instead")
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file("inceptionv3", root=root),
+                            ctx=ctx)
     return net

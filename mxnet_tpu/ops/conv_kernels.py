@@ -49,10 +49,14 @@ def _kern(dy_ref, wt_ref, dx_ref):
     dx_ref[:, :, 0, :, 0:C] = _cast(res, dx_ref.dtype).reshape(bn, Ho, Wo, C)
 
 
-def _pick_bn(N, Ho, Wo, K, C, itemsize, budget=10 * 1024 * 1024):
-    """Largest batch block (divisor of N) whose dy + dx VMEM blocks fit."""
-    per_img = Ho * Wo * (K + 4 * C) * itemsize
-    bn = max(1, min(N, budget // max(per_img, 1)))
+def _pick_bn(N, Ho, Wo, K, C, itemsize, budget=13 * 1024 * 1024):
+    """Largest batch block (divisor of N) fitting the 16M scoped-VMEM
+    limit: Mosaic DOUBLE-BUFFERS the grid-revolving dy/dx blocks (x2
+    below), the weight block is grid-invariant (resident once), and the
+    budget leaves headroom for the matmul accumulator."""
+    per_img = 2 * Ho * Wo * (K + 4 * C) * itemsize
+    fixed = 2 * K * C * itemsize
+    bn = max(1, min(N, (budget - fixed) // max(per_img, 1)))
     while N % bn:
         bn -= 1
     return bn
@@ -78,11 +82,15 @@ def conv1x1_s2_dgrad(dy, w2, H, W):
         _kern,
         grid=(N // bn,),
         in_specs=[
-            pl.BlockSpec((bn, Ho, Wo, K), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((K, C), lambda i: (0, 0)),
+            # z = i * 0 keeps every index-map result i32-typed: literal
+            # zeros fold to i64 under this Mosaic version and its
+            # func.return legalization rejects the mixed (i32, i64...)
+            pl.BlockSpec((bn, Ho, Wo, K),
+                         lambda i: (i, i * 0, i * 0, i * 0)),
+            pl.BlockSpec((K, C), lambda i: (i * 0, i * 0)),
         ],
         out_specs=pl.BlockSpec((bn, Ho, 2, Wo, 2 * C),
-                               lambda i: (i, 0, 0, 0, 0)),
+                               lambda i: (i, i * 0, i * 0, i * 0, i * 0)),
         out_shape=jax.ShapeDtypeStruct((N, Ho, 2, Wo, 2 * C), dy.dtype),
         interpret=_interpret(),
     )(dy, w2)

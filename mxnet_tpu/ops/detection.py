@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
 from .registry import register
 from .contrib_ops import box_iou_xyxy
 
@@ -777,10 +778,22 @@ def _bbox_transform_norm(ex, gt, mean, std):
 
 def _sample_rois_one_image(key, rois_i, gt_i, img_idx, *, rois_per_image,
                            fg_cap, num_classes, fg_thresh, bg_hi, bg_lo,
-                           without_gt, mean, std, weight):
+                           without_gt, mean, std, weight, score_i=None):
     """Fixed-shape ROI sampling for one image (proposal_target.cc:22-164
     SampleROI). random_shuffle+resize becomes rank-by-random-key selection:
     fg first, then bg, then negatives pad the remainder.
+
+    score_i — optional (R, num_classes) predicted class probabilities for
+    OHEM (online hard example mining): selection ranks candidates
+    hardest-first by classification loss (-log p[assigned label] for fg,
+    -log p[background] for bg) instead of randomly.  The reference
+    DECLARES the `ohem` param but its branch is
+    `LOG(FATAL) << "OHEM not Implemented."`
+    (proposal_target-inl.h:133, proposal_mask_target-inl.h:144) — this
+    implementation goes beyond it, following Shrivastava et al.'s OHEM
+    with the fg/bg quota semantics kept identical to the random path.
+    Appended gt boxes carry no prediction; they rank hardest among fg
+    (the gradient-richest positives are never dropped).
 
     Returns (kept_rows(rois_per_image,5), labels, targets, weights,
     kept_gt_assignment) — the assignment is reused by ProposalMaskTarget.
@@ -814,16 +827,29 @@ def _sample_rois_one_image(key, rois_i, gt_i, img_idx, *, rois_per_image,
     neg = cand_valid & ~fg
 
     k1, k2, k3 = jax.random.split(key, 3)
-    fg_rank = _masked_rank(jax.random.uniform(k1, (N,)), fg)
+    if score_i is None:
+        fg_key = jax.random.uniform(k1, (N,))
+        bg_key = jax.random.uniform(k2, (N,))
+        pad_key = jax.random.uniform(k3, (N,))
+    else:
+        # OHEM: rank ascending by NEGATIVE loss => hardest first.  A bg
+        # candidate's loss is against the background class regardless of
+        # its argmax-overlap label.
+        tgt = jnp.where(fg[:R], cand_label[:R], 0.0).astype(jnp.int32)
+        p = score_i[jnp.arange(R), tgt]
+        hard = -jnp.log(jnp.maximum(p, 1e-12))
+        hard = jnp.concatenate([hard, jnp.full((G,), jnp.inf)])
+        fg_key = bg_key = pad_key = -hard
+    fg_rank = _masked_rank(fg_key, fg)
     n_fg = jnp.minimum(jnp.sum(fg), fg_cap)
     sel_fg = fg & (fg_rank < n_fg)
-    bg_rank = _masked_rank(jax.random.uniform(k2, (N,)), bg)
+    bg_rank = _masked_rank(bg_key, bg)
     n_bg = jnp.minimum(jnp.sum(bg), rois_per_image - n_fg)
     sel_bg = bg & (bg_rank < n_bg)
     # pad the remainder from the negative pool (reference pads by an
     # independent shuffle of neg_indexes, possibly duplicating a bg row;
     # we select distinct rows instead)
-    pad_rank = _masked_rank(jax.random.uniform(k3, (N,)), neg & ~sel_bg)
+    pad_rank = _masked_rank(pad_key, neg & ~sel_bg)
     sel_pad = (neg & ~sel_bg) & (pad_rank < rois_per_image - n_fg - n_bg)
 
     cat = jnp.where(sel_fg, 0, jnp.where(sel_bg, 1, jnp.where(sel_pad, 2, 3)))
@@ -869,14 +895,29 @@ def _pt_params(params):
                                    (0.1, 0.1, 0.2, 0.2)), jnp.float32)
     weight = jnp.asarray(_tuple_param(params, "bbox_weight",
                                       (1.0, 1.0, 1.0, 1.0)), jnp.float32)
-    if params.get("ohem", False):
-        raise NotImplementedError("OHEM not implemented (reference "
-                                  "proposal_target-inl.h:133 raises too)")
     return mean, std, weight
 
 
+def _ohem_scores(params, extra, op_name):
+    """Resolve the optional cls_prob input for ohem=True.
+
+    The reference declares `ohem` on both target ops but its branch is
+    LOG(FATAL) (proposal_target-inl.h:133) — here it is implemented
+    (hardest-first sampling, see _sample_rois_one_image) and needs the
+    predicted (B, R, num_classes) class probabilities as an extra input.
+    """
+    if not _bool_param(params, "ohem"):
+        return None
+    if not extra:
+        raise MXNetError(
+            "%s(ohem=True) needs a cls_prob input of shape "
+            "(batch, rois, num_classes) — predicted probabilities to rank "
+            "hard examples by loss" % op_name)
+    return lax.stop_gradient(extra[0])
+
+
 @register("ProposalTarget", num_outputs=4, need_rng=True)
-def _proposal_target(params, rois, gt_boxes):
+def _proposal_target(params, rois, gt_boxes, *cls_prob):
     """Faster-RCNN ROI sampling + regression targets (fork
     src/operator/proposal_target-inl.h:26-199, proposal_target.cc:22-164).
 
@@ -885,7 +926,11 @@ def _proposal_target(params, rois, gt_boxes):
     rois (batch_rois, 5), label (batch_rois,), bbox_target / bbox_weight
     (batch_rois, num_classes*4). Gradients are zero (reference Backward
     writes zeros) — the whole op sits under stop_gradient.
+
+    ohem=True ranks candidates hardest-first by loss against the extra
+    cls_prob input instead of sampling randomly (see _ohem_scores).
     """
+    score = _ohem_scores(params, cls_prob, "ProposalTarget")
     rois = lax.stop_gradient(rois)
     gt_boxes = lax.stop_gradient(gt_boxes)
     num_classes = int(params["num_classes"])
@@ -897,7 +942,7 @@ def _proposal_target(params, rois, gt_boxes):
     B = rois.shape[0]
     keys = jax.random.split(params["_rng_key"], B)
 
-    def one(key, rois_i, gt_i, idx):
+    def one(key, rois_i, gt_i, idx, score_i):
         r = _sample_rois_one_image(
             key, rois_i, gt_i, idx, rois_per_image=rois_per_image,
             fg_cap=fg_cap, num_classes=num_classes,
@@ -905,11 +950,16 @@ def _proposal_target(params, rois, gt_boxes):
             bg_hi=float(params["bg_thresh_hi"]),
             bg_lo=float(params["bg_thresh_lo"]),
             without_gt=_bool_param(params, "proposal_without_gt"),
-            mean=mean, std=std, weight=weight)
+            mean=mean, std=std, weight=weight, score_i=score_i)
         return r[:4]
 
-    out_rois, labels, targets, weights = jax.vmap(one)(
-        keys, rois, gt_boxes, jnp.arange(B))
+    if score is None:
+        one_fn = lambda k, r, g, i: one(k, r, g, i, None)
+        out_rois, labels, targets, weights = jax.vmap(one_fn)(
+            keys, rois, gt_boxes, jnp.arange(B))
+    else:
+        out_rois, labels, targets, weights = jax.vmap(one)(
+            keys, rois, gt_boxes, jnp.arange(B), score)
     return (out_rois.reshape(batch_rois, 5),
             labels.reshape(batch_rois),
             targets.reshape(batch_rois, num_classes * 4),
@@ -971,7 +1021,7 @@ def _rasterize_poly(poly, roi, mask_size, num_classes):
 
 
 @register("ProposalMaskTarget", num_outputs=5, need_rng=True)
-def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
+def _proposal_mask_target(params, rois, gt_boxes, gt_polys, *cls_prob):
     """Mask-RCNN ROI sampling: ProposalTarget plus per-foreground-roi mask
     targets (fork src/operator/proposal_mask_target-inl.h:26-216,
     proposal_mask_target.cc:20-202; COCO RLE utils src/coco_api/).
@@ -980,6 +1030,7 @@ def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
     Extra output mask_target (batch_images*img_rois*fg_fraction,
     num_classes, mask_size, mask_size), -1 off-category / non-fg.
     """
+    score = _ohem_scores(params, cls_prob, "ProposalMaskTarget")
     rois = lax.stop_gradient(rois)
     gt_boxes = lax.stop_gradient(gt_boxes)
     gt_polys = lax.stop_gradient(gt_polys)
@@ -993,7 +1044,7 @@ def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
     B = rois.shape[0]
     keys = jax.random.split(params["_rng_key"], B)
 
-    def one(key, rois_i, gt_i, polys_i, idx):
+    def one(key, rois_i, gt_i, polys_i, idx, score_i):
         kept_rows, labels, targets, weights, gt_assign, n_fg = \
             _sample_rois_one_image(
                 key, rois_i, gt_i, idx, rois_per_image=img_rois,
@@ -1002,7 +1053,7 @@ def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
                 bg_hi=float(params["bg_thresh_hi"]),
                 bg_lo=float(params["bg_thresh_lo"]),
                 without_gt=_bool_param(params, "proposal_without_gt"),
-                mean=mean, std=std, weight=weight)
+                mean=mean, std=std, weight=weight, score_i=score_i)
 
         def mask_row(j):
             m = _rasterize_poly(polys_i[gt_assign[j]], kept_rows[j],
@@ -1012,8 +1063,13 @@ def _proposal_mask_target(params, rois, gt_boxes, gt_polys):
         masks = jax.vmap(mask_row)(jnp.arange(fg_cap))
         return kept_rows, labels, targets, weights, masks
 
-    out_rois, labels, targets, weights, masks = jax.vmap(one)(
-        keys, rois, gt_boxes, gt_polys, jnp.arange(B))
+    if score is None:
+        one_fn = lambda k, r, g, p, i: one(k, r, g, p, i, None)
+        out_rois, labels, targets, weights, masks = jax.vmap(one_fn)(
+            keys, rois, gt_boxes, gt_polys, jnp.arange(B))
+    else:
+        out_rois, labels, targets, weights, masks = jax.vmap(one)(
+            keys, rois, gt_boxes, gt_polys, jnp.arange(B), score)
     batch_rois = batch_images * img_rois
     return (out_rois.reshape(batch_rois, 5),
             labels.reshape(batch_rois),

@@ -18,108 +18,30 @@
 #include <Python.h>
 
 #include <cstring>
-#include <mutex>
 #include <stdexcept>
 #include <string>
 #include <vector>
 
 #include "error.h"
+#include "py_embed.h"
 
-typedef unsigned int mx_uint;
-typedef float mx_float;
 typedef void *PredictorHandle;
 
-#define MXTPU_DLL extern "C" __attribute__((visibility("default")))
-
 namespace {
+
+using mxtpu::py::Check;
+using mxtpu::py::EnsurePython;
+using mxtpu::py::Gil;
+using mxtpu::py::PyRef;
+using mxtpu::py::ShapesFromCsr;
 
 struct Pred {
   PyObject *obj = nullptr;            // mxnet_tpu.predict.Predictor
   std::vector<mx_uint> shape_buf;     // MXPredGetOutputShape storage
 };
 
-std::mutex g_init_mu;
-
-void EnsurePython() {
-  // serialized: Py_InitializeEx is not thread-safe, and a second thread
-  // must not PyGILState_Ensure on a half-initialized interpreter
-  std::lock_guard<std::mutex> lock(g_init_mu);
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // drop the init-acquired GIL; every entry point re-takes it via
-    // PyGILState_Ensure so calls work from any thread
-    PyEval_SaveThread();
-  }
-}
-
-struct Gil {
-  PyGILState_STATE st;
-  Gil() { st = PyGILState_Ensure(); }
-  ~Gil() { PyGILState_Release(st); }
-};
-
-std::string PyErrString() {
-  PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
-  PyErr_Fetch(&t, &v, &tb);
-  PyErr_NormalizeException(&t, &v, &tb);
-  std::string out = "python error";
-  if (v != nullptr) {
-    PyObject *s = PyObject_Str(v);
-    if (s != nullptr) {
-      const char *c = PyUnicode_AsUTF8(s);
-      if (c != nullptr) out = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(t);
-  Py_XDECREF(v);
-  Py_XDECREF(tb);
-  return out;
-}
-
-PyObject *Check(PyObject *o) {
-  if (o == nullptr) throw std::runtime_error(PyErrString());
-  return o;
-}
-
-/*! \brief owned reference: decrefs on every exit path (Check throws) */
-struct PyRef {
-  PyObject *p;
-  explicit PyRef(PyObject *o = nullptr) : p(o) {}
-  ~PyRef() { Py_XDECREF(p); }
-  PyObject *get() const { return p; }
-  PyObject *release() {
-    PyObject *r = p;
-    p = nullptr;
-    return r;
-  }
-  PyRef(const PyRef &) = delete;
-  PyRef &operator=(const PyRef &) = delete;
-};
-
 PyObject *Helper(const char *name) {
-  PyObject *mod = Check(PyImport_ImportModule("mxnet_tpu.predict"));
-  PyObject *fn = PyObject_GetAttrString(mod, name);
-  Py_DECREF(mod);
-  return Check(fn);
-}
-
-/* (keys, indptr, shape_data) CSR triple -> ([keys...], [shape tuples...]) */
-void ShapesFromCsr(mx_uint n, const char **keys, const mx_uint *indptr,
-                   const mx_uint *shape_data, PyObject **out_keys,
-                   PyObject **out_shapes) {
-  PyObject *k = Check(PyList_New(n));
-  PyObject *s = Check(PyList_New(n));
-  for (mx_uint i = 0; i < n; ++i) {
-    PyList_SET_ITEM(k, i, Check(PyUnicode_FromString(keys[i])));
-    mx_uint lo = indptr[i], hi = indptr[i + 1];
-    PyObject *shp = Check(PyTuple_New(hi - lo));
-    for (mx_uint j = lo; j < hi; ++j)
-      PyTuple_SET_ITEM(shp, j - lo, Check(PyLong_FromUnsignedLong(shape_data[j])));
-    PyList_SET_ITEM(s, i, shp);
-  }
-  *out_keys = k;
-  *out_shapes = s;
+  return mxtpu::py::Helper("mxnet_tpu.predict", name);
 }
 
 }  // namespace

@@ -1,0 +1,236 @@
+/*!
+ * MXT* C TRAIN surface: symbol-from-JSON -> module bind/init/step from a
+ * non-Python host process.
+ *
+ * Reference parity target: the cpp-package trains real models over the
+ * 183-fn include/mxnet/c_api.h (NDArray/Symbol/Executor/Optimizer,
+ * driven by cpp-package/include/mxnet-cpp/MxNetCpp.h and examples like
+ * cpp-package/example/lenet.cpp). This framework keeps the layering but
+ * shrinks the ABI to the module-level train loop: each call delegates to
+ * mxnet_tpu/ctrain.py over the embedded interpreter (same pattern as the
+ * MXPred* surface, c_predict_api.cc), so a C++ host drives the SAME
+ * fused fwd/bwd/update XLA program as Python's Module.fit.
+ *
+ * All buffers are float32, caller-owned, host memory.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "error.h"
+#include "py_embed.h"
+
+typedef void *ModuleHandle;
+
+namespace {
+
+using mxtpu::py::Check;
+using mxtpu::py::EnsurePython;
+using mxtpu::py::Gil;
+using mxtpu::py::PyRef;
+using mxtpu::py::ShapesFromCsr;
+
+struct Mod {
+  PyObject *obj = nullptr;            // mxnet_tpu.ctrain.CTrainer
+  std::vector<mx_uint> shape_buf;     // MXTModuleGetOutputShape storage
+};
+
+PyObject *Helper(const char *name) {
+  return mxtpu::py::Helper("mxnet_tpu.ctrain", name);
+}
+
+/*! \brief [name, buffer] pairs -> ([names...], [memoryviews...]) */
+void BuffersToPy(mx_uint n, const char **keys, const mx_float **bufs,
+                 const mx_uint *sizes, PyObject **out_keys,
+                 PyObject **out_views) {
+  PyObject *k = Check(PyList_New(n));
+  PyObject *v = Check(PyList_New(n));
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(k, i, Check(PyUnicode_FromString(keys[i])));
+    PyList_SET_ITEM(
+        v, i,
+        Check(PyMemoryView_FromMemory(
+            reinterpret_cast<char *>(const_cast<mx_float *>(bufs[i])),
+            static_cast<Py_ssize_t>(sizes[i]) * sizeof(mx_float),
+            PyBUF_READ)));
+  }
+  *out_keys = k;
+  *out_views = v;
+}
+
+}  // namespace
+
+MXTPU_DLL int MXTModuleCreate(const char *symbol_json, int dev_type,
+                              int dev_id, mx_uint num_data,
+                              const char **data_keys, mx_uint num_label,
+                              const char **label_keys, ModuleHandle *out) {
+  MXT_API_BEGIN();
+  EnsurePython();
+  Gil gil;
+  PyRef dk(Check(PyList_New(num_data)));
+  for (mx_uint i = 0; i < num_data; ++i)
+    PyList_SET_ITEM(dk.get(), i, Check(PyUnicode_FromString(data_keys[i])));
+  PyRef lk(Check(PyList_New(num_label)));
+  for (mx_uint i = 0; i < num_label; ++i)
+    PyList_SET_ITEM(lk.get(), i,
+                    Check(PyUnicode_FromString(label_keys[i])));
+  PyRef fn(Helper("_c_create"));
+  PyRef tr(Check(PyObject_CallFunction(fn.get(), "siiOO", symbol_json,
+                                       dev_type, dev_id, dk.get(),
+                                       lk.get())));
+  Mod *m = new Mod();
+  m->obj = tr.release();
+  *out = m;
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleBind(ModuleHandle handle, mx_uint num_inputs,
+                            const char **input_keys,
+                            const mx_uint *shape_indptr,
+                            const mx_uint *shape_data) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyObject *k = nullptr, *s = nullptr;
+  ShapesFromCsr(num_inputs, input_keys, shape_indptr, shape_data, &k, &s);
+  PyRef keys(k), shapes(s);
+  PyRef fn(Helper("_c_bind"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "OOO", m->obj, keys.get(),
+                                      shapes.get())));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleInitParams(ModuleHandle handle,
+                                  const char *initializer, int seed) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyRef fn(Helper("_c_init_params"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "Osi", m->obj, initializer,
+                                      seed)));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleInitOptimizer(ModuleHandle handle, const char *name,
+                                     mx_uint num_params, const char **keys,
+                                     const char **vals) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyRef k(Check(PyList_New(num_params)));
+  PyRef v(Check(PyList_New(num_params)));
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(k.get(), i, Check(PyUnicode_FromString(keys[i])));
+    PyList_SET_ITEM(v.get(), i, Check(PyUnicode_FromString(vals[i])));
+  }
+  PyRef fn(Helper("_c_init_optimizer"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "OsOO", m->obj, name,
+                                      k.get(), v.get())));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleStep(ModuleHandle handle, mx_uint num_inputs,
+                            const char **input_keys,
+                            const mx_float **buffers,
+                            const mx_uint *sizes) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyObject *k = nullptr, *v = nullptr;
+  BuffersToPy(num_inputs, input_keys, buffers, sizes, &k, &v);
+  PyRef keys(k), views(v);
+  PyRef fn(Helper("_c_step"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "OOO", m->obj, keys.get(),
+                                      views.get())));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleForward(ModuleHandle handle, mx_uint num_inputs,
+                               const char **input_keys,
+                               const mx_float **buffers,
+                               const mx_uint *sizes) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyObject *k = nullptr, *v = nullptr;
+  BuffersToPy(num_inputs, input_keys, buffers, sizes, &k, &v);
+  PyRef keys(k), views(v);
+  PyRef fn(Helper("_c_forward"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "OOO", m->obj, keys.get(),
+                                      views.get())));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleGetOutputShape(ModuleHandle handle, mx_uint index,
+                                      mx_uint **shape_data,
+                                      mx_uint *shape_ndim) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyRef fn(Helper("_c_output_shape"));
+  PyRef shp(Check(PyObject_CallFunction(fn.get(), "OI", m->obj, index)));
+  Py_ssize_t n = PyTuple_Size(shp.get());
+  m->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    unsigned long d = PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp.get(), i));
+    if (d == static_cast<unsigned long>(-1) && PyErr_Occurred()) {
+      PyErr_Clear();
+      throw std::runtime_error("output shape dim " + std::to_string(i) +
+                               " is not an unsigned integer");
+    }
+    m->shape_buf[i] = static_cast<mx_uint>(d);
+  }
+  *shape_data = m->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleGetOutput(ModuleHandle handle, mx_uint index,
+                                 mx_float *data, mx_uint size) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyRef fn(Helper("_c_output_bytes"));
+  PyRef b(Check(PyObject_CallFunction(fn.get(), "OI", m->obj, index)));
+  Py_ssize_t nbytes = PyBytes_Size(b.get());
+  if (nbytes != static_cast<Py_ssize_t>(size * sizeof(mx_float))) {
+    throw std::runtime_error("output size mismatch: have " +
+                             std::to_string(nbytes / sizeof(mx_float)) +
+                             " floats, caller asked " +
+                             std::to_string(size));
+  }
+  std::memcpy(data, PyBytes_AsString(b.get()), nbytes);
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleSaveCheckpoint(ModuleHandle handle,
+                                      const char *prefix, int epoch) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyRef fn(Helper("_c_save_checkpoint"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "Osi", m->obj, prefix,
+                                      epoch)));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleLoadParams(ModuleHandle handle, const char *path) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  PyRef fn(Helper("_c_load_params"));
+  PyRef r(Check(PyObject_CallFunction(fn.get(), "Os", m->obj, path)));
+  MXT_API_END();
+}
+
+MXTPU_DLL int MXTModuleFree(ModuleHandle handle) {
+  MXT_API_BEGIN();
+  Gil gil;
+  Mod *m = static_cast<Mod *>(handle);
+  Py_XDECREF(m->obj);
+  delete m;
+  MXT_API_END();
+}

@@ -1,0 +1,119 @@
+/*!
+ * Embedded-CPython scaffolding shared by the MXPred* predict ABI
+ * (c_predict_api.cc) and the MXT* train ABI (c_train_api.cc).
+ *
+ * Layering (mirrors reference src/c_api/: thin C shims over the engine):
+ * the C surface embeds one CPython interpreter and delegates every call
+ * to `_c_*` helpers in mxnet_tpu — device compute stays the jitted XLA
+ * program either way, so C and Python hosts run the identical path.
+ */
+#ifndef MXTPU_PY_EMBED_H_
+#define MXTPU_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <stdexcept>
+#include <string>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+#define MXTPU_DLL extern "C" __attribute__((visibility("default")))
+
+namespace mxtpu {
+namespace py {
+
+inline std::mutex &InitMutex() {
+  static std::mutex mu;
+  return mu;
+}
+
+inline void EnsurePython() {
+  // serialized: Py_InitializeEx is not thread-safe, and a second thread
+  // must not PyGILState_Ensure on a half-initialized interpreter
+  std::lock_guard<std::mutex> lock(InitMutex());
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // drop the init-acquired GIL; every entry point re-takes it via
+    // PyGILState_Ensure so calls work from any thread
+    PyEval_SaveThread();
+  }
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+inline std::string PyErrString() {
+  PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
+  PyErr_Fetch(&t, &v, &tb);
+  PyErr_NormalizeException(&t, &v, &tb);
+  std::string out = "python error";
+  if (v != nullptr) {
+    PyObject *s = PyObject_Str(v);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) out = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+  return out;
+}
+
+inline PyObject *Check(PyObject *o) {
+  if (o == nullptr) throw std::runtime_error(PyErrString());
+  return o;
+}
+
+/*! \brief owned reference: decrefs on every exit path (Check throws) */
+struct PyRef {
+  PyObject *p;
+  explicit PyRef(PyObject *o = nullptr) : p(o) {}
+  ~PyRef() { Py_XDECREF(p); }
+  PyObject *get() const { return p; }
+  PyObject *release() {
+    PyObject *r = p;
+    p = nullptr;
+    return r;
+  }
+  PyRef(const PyRef &) = delete;
+  PyRef &operator=(const PyRef &) = delete;
+};
+
+/*! \brief fetch helper `name` from python module `module` */
+inline PyObject *Helper(const char *module, const char *name) {
+  PyObject *mod = Check(PyImport_ImportModule(module));
+  PyObject *fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  return Check(fn);
+}
+
+/* (keys, indptr, shape_data) CSR triple -> ([keys...], [shape tuples...]) */
+inline void ShapesFromCsr(mx_uint n, const char **keys,
+                          const mx_uint *indptr, const mx_uint *shape_data,
+                          PyObject **out_keys, PyObject **out_shapes) {
+  PyObject *k = Check(PyList_New(n));
+  PyObject *s = Check(PyList_New(n));
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(k, i, Check(PyUnicode_FromString(keys[i])));
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *shp = Check(PyTuple_New(hi - lo));
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo,
+                       Check(PyLong_FromUnsignedLong(shape_data[j])));
+    PyList_SET_ITEM(s, i, shp);
+  }
+  *out_keys = k;
+  *out_shapes = s;
+}
+
+}  // namespace py
+}  // namespace mxtpu
+
+#endif  // MXTPU_PY_EMBED_H_

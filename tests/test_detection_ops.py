@@ -479,3 +479,56 @@ def test_post_detection_train_mode_raises():
         run_op("PostDetection", {"_is_train": True},
                np.zeros((2, 5), np.float32), np.zeros((1, 2, 2), np.float32),
                np.zeros((1, 2, 8), np.float32), np.ones((1, 3), np.float32))
+
+
+def test_proposal_target_ohem_selects_hardest():
+    """OHEM: fg/bg picked by classification loss, not randomly.
+
+    The reference DECLARES ohem on ProposalTarget but its branch is
+    LOG(FATAL) "OHEM not Implemented." (proposal_target-inl.h:133) — this
+    capability exceeds it; oracle is a numpy top-k by -log p.
+    """
+    import jax
+    # 1 image, 8 rois: 4 clear fg (IoU 1 with the gt), 4 clear bg
+    gt = np.array([[[10, 10, 40, 40, 2]]], np.float32)
+    rois = np.zeros((1, 8, 5), np.float32)
+    for i in range(4):
+        rois[0, i, 1:] = [10, 10, 40, 40]          # fg (IoU 1.0)
+    for i in range(4, 8):
+        rois[0, i, 1:] = [60 + i, 60, 80 + i, 80]  # bg (IoU 0)
+    # predicted probs: fg rois 0..3 have DESCENDING p[gt class] => roi 3
+    # is hardest; bg rois 4..7 have ASCENDING p[background] => roi 4 is
+    # hardest background
+    C = 3
+    score = np.full((1, 8, C), 0.01, np.float32)
+    score[0, :4, 2] = [0.9, 0.7, 0.5, 0.1]
+    score[0, 4:, 0] = [0.2, 0.4, 0.6, 0.9]
+    params = {"num_classes": C, "batch_images": 1, "batch_rois": 4,
+              "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+              "fg_fraction": 0.5, "ohem": True,
+              "proposal_without_gt": True,
+              "_rng_key": jax.random.PRNGKey(0)}
+    out, label, tgt, wt = run_op("ProposalTarget", params, rois, gt,
+                                 score)
+    # 2 fg slots: hardest fg are rois 3 (p=0.1) and 2 (p=0.5)
+    fg_rows = out[label > 0]
+    assert fg_rows.shape[0] == 2
+    np.testing.assert_allclose(fg_rows[:, 1:], [[10, 10, 40, 40]] * 2)
+    # 2 bg slots: hardest bg are rois 4 (p0=0.2) and 5 (p0=0.4)
+    bg_rows = out[label == 0]
+    got_x1 = sorted(bg_rows[:, 1].tolist())
+    assert got_x1 == [64.0, 65.0], got_x1
+    # determinism: same inputs, same selection (no RNG in the ranking)
+    out2, label2, _, _ = run_op("ProposalTarget", params, rois, gt, score)
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_proposal_target_ohem_needs_scores():
+    import jax
+    with pytest.raises(mx.base.MXNetError, match="cls_prob"):
+        run_op("ProposalTarget",
+               {"num_classes": 3, "batch_images": 1, "batch_rois": 4,
+                "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+                "ohem": True, "_rng_key": jax.random.PRNGKey(0)},
+               np.zeros((1, 4, 5), np.float32),
+               np.zeros((1, 1, 5), np.float32))

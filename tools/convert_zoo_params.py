@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Convert a reference MXNet gluon model-zoo ``.params`` file for this
+framework's model zoo (reference
+`python/mxnet/gluon/model_zoo/model_store.py:70-105` downloads them; this
+environment has no egress, so conversion starts from a user-supplied
+file).
+
+What conversion actually does:
+  * reads the reference ndarray container byte format (the repo's reader
+    is byte-compatible, `mxnet_tpu/ndarray/ndarray.py` save/load);
+  * strips ``arg:``/``aux:`` key prefixes (files saved via
+    Module.save_checkpoint carry them; gluon save_params files don't);
+  * normalizes the gluon name prefix (e.g. ``resnetv10_``) — kept,
+    added, or stripped to match the target net's ``collect_params()``
+    naming (this repo's zoo mirrors reference naming, so usually a no-op);
+  * optionally transposes 4-D conv weights OIHW -> OHWI for a
+    ``layout="NHWC"`` target net (--layout NHWC);
+  * writes the result back in the same byte format, named
+    ``<model>.params`` under --out-dir so
+    ``vision.<model>(pretrained=True, root=<out-dir>)`` resolves it
+    (model_store.get_model_file searches root then MXNET_TPU_MODEL_DIR).
+
+Verification: --verify MODEL loads the converted file into the zoo net
+and forward-runs a fixed input, printing an output checksum; run it on
+both sides (reference GPU box / here) to confirm the port.
+
+Usage:
+    python tools/convert_zoo_params.py resnet50_v1-0000.params \
+        --model resnet50_v1 --out-dir ~/.mxnet/models [--layout NHWC]
+        [--verify]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def load_reference_params(path):
+    """name -> NDArray with arg:/aux: prefixes stripped."""
+    import mxnet_tpu as mx
+    raw = mx.nd.load(path)
+    if not isinstance(raw, dict):
+        raise SystemExit("%s holds a list, not a name->array dict — not a "
+                         "zoo params file" % path)
+    out = {}
+    for k, v in raw.items():
+        if k.startswith("arg:") or k.startswith("aux:"):
+            k = k.split(":", 1)[1]
+        out[k] = v
+    return out
+
+
+_PREFIX_RE = re.compile(r"^[a-z0-9]+\d+_")
+
+
+def match_names(params, target_names):
+    """Map loaded names onto the target net's parameter names.
+
+    Tries, in order: exact match; stripping the leading gluon prefix from
+    both sides (``resnetv10_conv0_weight`` ~ ``conv0_weight``); and
+    re-prefixing with the target's own prefix.  Returns (mapped, missing,
+    unused)."""
+    mapped, used = {}, set()
+    by_bare = {}
+    for k in params:
+        by_bare.setdefault(_PREFIX_RE.sub("", k), k)
+    for tname in target_names:
+        if tname in params:
+            mapped[tname] = params[tname]
+            used.add(tname)
+            continue
+        bare = _PREFIX_RE.sub("", tname)
+        src = by_bare.get(bare)
+        if src is not None:
+            mapped[tname] = params[src]
+            used.add(src)
+    missing = [t for t in target_names if t not in mapped]
+    unused = [k for k in params if k not in used]
+    return mapped, missing, unused
+
+
+def to_nhwc(mapped):
+    """OIHW -> OHWI for every 4-D conv weight (NHWC target nets)."""
+    import mxnet_tpu as mx
+    out = {}
+    for k, v in mapped.items():
+        if k.endswith("_weight") and len(v.shape) == 4:
+            out[k] = mx.nd.array(v.asnumpy().transpose(0, 2, 3, 1),
+                                 dtype=v.dtype)
+        else:
+            out[k] = v
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("params", help="reference zoo .params file")
+    ap.add_argument("--model", required=True,
+                    help="zoo model name, e.g. resnet50_v1")
+    ap.add_argument("--out-dir", default=os.path.expanduser(
+        os.path.join("~", ".mxnet", "models")))
+    ap.add_argument("--layout", choices=["NCHW", "NHWC"], default="NCHW")
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--verify", action="store_true",
+                    help="load via pretrained=True and print an output "
+                         "checksum on a fixed input")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    kwargs = {"classes": args.classes}
+    if args.layout != "NCHW":
+        kwargs["layout"] = args.layout
+    net = vision.get_model(args.model, **kwargs)
+    net.initialize(mx.init.Xavier())
+    side = 299 if args.model == "inceptionv3" else 224  # zoo registry name
+    shape = ((1, 3, side, side) if args.layout == "NCHW"
+             else (1, side, side, 3))
+    net(mx.nd.zeros(shape))  # materialize shapes
+    target_names = list(net.collect_params().keys())
+
+    params = load_reference_params(args.params)
+    mapped, missing, unused = match_names(params, target_names)
+    if args.layout == "NHWC":
+        mapped = to_nhwc(mapped)
+    print("matched %d/%d target params (%d source arrays unused)"
+          % (len(mapped), len(target_names), len(unused)))
+    if missing:
+        raise SystemExit("unmatched target params (first 10): %s"
+                         % missing[:10])
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    out_path = os.path.join(args.out_dir, "%s.params" % args.model)
+    # gluon zoo convention (reference block.py:344 save_params): keys are
+    # saved with the net prefix STRIPPED; load_parameters restores the
+    # loading net's own prefix
+    prefix = net.prefix
+    bare = {(k[len(prefix):] if k.startswith(prefix) else k): v
+            for k, v in mapped.items()}
+    mx.nd.save(out_path, bare)
+    print("wrote", out_path)
+
+    if args.verify:
+        net2 = vision.get_model(args.model, pretrained=True,
+                                root=args.out_dir, **kwargs)
+        x = mx.nd.array(np.linspace(-1, 1, int(np.prod(shape)),
+                                    dtype=np.float32).reshape(shape))
+        y = net2(x).asnumpy()
+        print("verify: output[0,:5] =", np.round(y[0, :5], 5),
+              "checksum %.6f" % float(np.abs(y).sum()))
+
+
+if __name__ == "__main__":
+    main()

@@ -89,27 +89,43 @@ def make_fns(Ho, K, C, stride, dtype):
     return fns
 
 
-def time_fn(fn, dy, w2, iters, rounds):
-    def body(c, _):
-        dx = fn(dy, w2 * c)
-        c = 1.0 + dx.ravel()[0].astype(jnp.float32) * 1e-30
-        return c, ()
+def time_fn(fn, dy, w2, iters, rounds, calls=6):
+    """Per-op seconds: `calls` chained scan dispatches of `iters`
+    iterations each, ONE scalar readback at the end — the ~90 ms tunnel
+    sync cost amortizes over iters*calls executions (same discipline as
+    bench.py; at 30 iters/1 call it floored every op at ~3 ms/iter)."""
+    @jax.jit
+    def run(c, dy, w2):
+        # dy/w2 as ARGUMENTS: closing over them bakes multi-MB constants
+        # into the MLIR payload (25 MB for the c3 shapes), which the
+        # remote compile helper rejects
+        def body(c, _):
+            dx = fn(dy, (w2 * c).astype(w2.dtype))
+            # the carry must consume ALL of dx: a single-element read
+            # lets XLA slice straight through the conv/dot (slice-of-conv
+            # -> tiny conv) and the "measurement" times dead code.  The
+            # full-array sum costs one extra dx read — identical across
+            # variants of the same shape.
+            return 1.0 + jnp.sum(dx.astype(jnp.float32)) * 1e-30, ()
+        return lax.scan(body, c, None, length=iters)[0]
 
-    run = jax.jit(lambda c: lax.scan(body, c, None, length=iters)[0])
-    out = run(jnp.float32(1.0))
-    float(out)  # compile + warm
+    float(run(jnp.float32(1.0), dy, w2))  # compile + warm
     best = float("inf")
     for _ in range(rounds):
         t0 = time.perf_counter()
-        float(run(jnp.float32(1.0)))
+        c = jnp.float32(1.0)
+        for _ in range(calls):
+            c = run(c, dy, w2)
+        float(c)
         best = min(best, time.perf_counter() - t0)
-    return best / iters
+    return best / (iters * calls)
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--iters", type=int, default=30)
-    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--calls", type=int, default=6)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--only", default=None, help="substring filter on shape")
     p.add_argument("--variants", default=None,
@@ -129,10 +145,11 @@ def main():
             if args.variants and vname not in args.variants.split(","):
                 continue
             try:
-                sec = time_fn(fn, dy, w2, args.iters, args.rounds)
+                sec = time_fn(fn, dy, w2, args.iters, args.rounds,
+                              args.calls)
             except Exception as e:
                 print(json.dumps({"shape": name, "variant": vname,
-                                  "error": str(e)[:200]}), flush=True)
+                                  "error": str(e)[:2000]}), flush=True)
                 continue
             print(json.dumps({
                 "shape": name, "variant": vname,
