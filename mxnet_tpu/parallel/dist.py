@@ -35,9 +35,18 @@ _HB_STOP = None
 _HB_PREFIX = "mxnet_tpu_hb"
 
 
-def init(coordinator_address=None, num_processes=None, process_id=None):
+def init(coordinator_address=None, num_processes=None, process_id=None,
+         recoverable=None):
     """Initialise multi-process JAX (reference `InitPSEnv`, kvstore.h:254;
-    env vars DMLC_* are honored for launcher compatibility)."""
+    env vars DMLC_* are honored for launcher compatibility).
+
+    recoverable (or MXNET_RECOVERABLE=1): register THIS process as a
+    recoverable cluster member — its crash is reported through the
+    heartbeat/`get_num_dead_node` protocol instead of the coordination
+    service broadcasting a fatal error that aborts every healthy peer
+    (the reference's ps-lite likewise keeps workers up when a peer dies
+    and surfaces it via the scheduler's heartbeat bookkeeping, van.cc).
+    """
     global _initialized
     if _initialized:
         return
@@ -46,8 +55,15 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
         num_processes = int(os.environ.get("DMLC_NUM_WORKER", "0")) or None
     if process_id is None and "DMLC_WORKER_ID" in os.environ:
         process_id = int(os.environ["DMLC_WORKER_ID"])
+    if recoverable is None:
+        recoverable = os.environ.get("MXNET_RECOVERABLE", "0") == "1"
     if coordinator_address:
-        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+        if recoverable:
+            _init_recoverable(coordinator_address, num_processes,
+                              process_id)
+        else:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
     _initialized = True
     # liveness protocol on by default for multi-process runs (reference
     # ps-lite heartbeats are always on, van.cc); cheap: one tiny KV write
@@ -55,6 +71,41 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
     if jax.process_count() > 1:
         start_heartbeat(float(os.environ.get(
             "MXNET_HEARTBEAT_INTERVAL", "5")))
+
+
+def _init_recoverable(coordinator_address, num_processes, process_id):
+    """jax.distributed.initialize with the runtime client's `recoverable`
+    flag set — not exposed through the public signature (jax 0.9), so the
+    client constructor is wrapped for the duration of the call; on ANY
+    incompatibility with this jax version (module moved, kwarg
+    unsupported), degrade to a plain initialize — a missing recoverable
+    flag must never stop the job from starting.
+    """
+    try:
+        from jax._src.lib import _jax as _jaxlib
+        orig = _jaxlib.get_distributed_runtime_client
+    except Exception:
+        import warnings
+        warnings.warn("recoverable init unsupported on this jax version; "
+                      "falling back to plain jax.distributed.initialize")
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+        return
+
+    def patched(*args, **kwargs):
+        kwargs["recoverable"] = True
+        try:
+            return orig(*args, **kwargs)
+        except TypeError:
+            kwargs.pop("recoverable", None)
+            return orig(*args, **kwargs)
+
+    _jaxlib.get_distributed_runtime_client = patched
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    finally:
+        _jaxlib.get_distributed_runtime_client = orig
 
 
 def rank():
@@ -209,8 +260,9 @@ def start_heartbeat(interval=5.0):
     import threading
     import time as _time
 
-    _HB_STOP = threading.Event()
-    me = jax.process_index()
+    stop_evt = threading.Event()  # captured by THIS thread: a stop/start
+    _HB_STOP = stop_evt           # pair must not hand the old thread the
+    me = jax.process_index()      # new thread's event (it would never stop)
 
     def beat():
         while True:
@@ -220,7 +272,7 @@ def start_heartbeat(interval=5.0):
                                      allow_overwrite=True)
             except Exception:  # pragma: no cover - coordinator gone
                 return
-            if _HB_STOP.wait(interval):
+            if stop_evt.wait(interval):
                 return
 
     _HB_THREAD = threading.Thread(target=beat, daemon=True,
@@ -230,11 +282,16 @@ def start_heartbeat(interval=5.0):
 
 
 def stop_heartbeat():
+    """Stop the liveness writer and WAIT for it: after return, no further
+    heartbeat reaches the coordinator (so a stopped node goes stale and
+    num_dead_nodes counts it)."""
     global _HB_THREAD, _HB_STOP
+    thread, _HB_THREAD = _HB_THREAD, None
     if _HB_STOP is not None:
         _HB_STOP.set()
-    _HB_THREAD = None
     _HB_STOP = None
+    if thread is not None:
+        thread.join(timeout=30)
 
 
 def num_dead_nodes(timeout=60):

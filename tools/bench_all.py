@@ -146,6 +146,9 @@ def main():
     ap.add_argument("--only", choices=sorted(CONFIGS), default=None)
     ap.add_argument("--out", default=None,
                     help="also write the combined records to this JSON file")
+    ap.add_argument("--round", type=int, default=None,
+                    help="build-round stamp recorded with the results so "
+                         "BENCH_EXTRA history stays diffable")
     args = ap.parse_args()
     names = [args.only] if args.only else list(CONFIGS)
     records = []
@@ -155,6 +158,8 @@ def main():
         except Exception as e:  # record the failure, keep benching
             rec = {"metric": name, "value": None, "unit": None,
                    "vs_baseline": None, "error": str(e)[:500]}
+        if args.round is not None:
+            rec["round"] = args.round
         print(json.dumps(rec), flush=True)
         records.append(rec)
     if args.out:
