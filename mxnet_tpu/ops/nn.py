@@ -463,6 +463,35 @@ def _deconvolution(params, data, weight, *bias):
 # ---------------------------------------------------------------------------
 # Pooling (reference nn/pooling-inl.h)
 # ---------------------------------------------------------------------------
+def _pool_max_slices(data, window, strides, padding, init):
+    """Strided max pool as an elementwise max over k^nd strided slices.
+
+    MXNET_POOL_SLICES, default OFF — measured 15% SLOWER end-to-end
+    (8,425 vs 9,966 img/s ResNet-50 bs32 inference; both numbers from
+    the same bench-loop variant in the same session — the canonical
+    baseline loop measures 10,033): reduce_window's
+    379 GB/s looked like bandwidth headroom, but the 9-slice maximum
+    chain materializes intermediates XLA's window emitter never builds.
+    Kept as the measured-negative-result artifact (same pattern as
+    MXNET_CONV1X1_*; see docs/perf/resnet50_train_attribution.md for
+    the methodology). Exact same values; autodiff gives a maximum-chain
+    VJP instead of select-and-scatter (grads agree up to tie-routing,
+    like the reference's cuDNN vs CPU pooling backends).
+    """
+    import itertools
+    padspec = [(lo, hi, 0) for lo, hi in padding]
+    xp = lax.pad(data, jnp.asarray(init, data.dtype), padspec)
+    out_sz = [(xp.shape[a] - window[a]) // strides[a] + 1
+              for a in range(data.ndim)]
+    out = None
+    for offs in itertools.product(*[range(k) for k in window]):
+        sl = tuple(slice(o, o + strides[a] * (out_sz[a] - 1) + 1,
+                         strides[a]) for a, o in enumerate(offs))
+        piece = xp[sl]
+        out = piece if out is None else jnp.maximum(out, piece)
+    return out
+
+
 @register("Pooling", aliases=("Pooling_v1",))
 def _pooling(params, data):
     pool_type = params.get("pool_type", "max")
@@ -510,7 +539,12 @@ def _pooling(params, data):
         _, _, padding = _full(kernel, stride, extra)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        out = lax.reduce_window(data, init, lax.max, window, strides, padding)
+        if (_env_on("MXNET_POOL_SLICES") and not global_pool
+                and max(stride) > 1 and int(np.prod(kernel)) <= 9):
+            out = _pool_max_slices(data, window, strides, padding, init)
+        else:
+            out = lax.reduce_window(data, init, lax.max, window, strides,
+                                    padding)
         if params.get("_fold_relu"):
             # executor relu->maxpool fold: maxpool(relu(x)) ==
             # max(maxpool(x), 0); grads agree (see _plan_relu_pool_fold)

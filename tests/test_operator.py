@@ -505,3 +505,38 @@ def test_batchnorm_backward_oracle():
             else:
                 np.testing.assert_allclose(g.grad.asnumpy(), dg_o,
                                            rtol=2e-4, atol=2e-4)
+
+
+def test_pool_slices_matches_reduce_window():
+    """MXNET_POOL_SLICES (slice-form strided max pool): forward exact,
+    gradients match the reduce_window lowering away from ties."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import nn as nn_ops
+
+    rng = np.random.RandomState(0)
+    # distinct values => no ties, so both backward conventions agree
+    x = jnp.asarray(rng.permutation(2 * 8 * 13 * 13).reshape(2, 8, 13, 13)
+                    .astype(np.float32))
+    params = {"kernel": (3, 3), "stride": (2, 2), "pad": (1, 1),
+              "pool_type": "max"}
+
+    def run(x):
+        return nn_ops._pooling(params, x)[0]
+
+    old = os.environ.get("MXNET_POOL_SLICES")
+    try:
+        os.environ["MXNET_POOL_SLICES"] = "0"
+        want = run(x)
+        gw = jax.grad(lambda v: jnp.sum(run(v) ** 2))(x)
+        os.environ["MXNET_POOL_SLICES"] = "1"
+        got = run(x)
+        gg = jax.grad(lambda v: jnp.sum(run(v) ** 2))(x)
+    finally:
+        if old is None:
+            os.environ.pop("MXNET_POOL_SLICES", None)
+        else:
+            os.environ["MXNET_POOL_SLICES"] = old
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(gw), rtol=1e-6)
