@@ -540,3 +540,37 @@ def test_pool_slices_matches_reduce_window():
             os.environ["MXNET_POOL_SLICES"] = old
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     np.testing.assert_allclose(np.asarray(gg), np.asarray(gw), rtol=1e-6)
+
+
+def test_space_to_depth_conv_nhwc_matches_direct():
+    """NHWC twin of the stem rewrite (round 5): exact same function as
+    the stride-2 NHWC conv, gradients included."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.ops.nn import _space_to_depth_conv_nhwc
+
+    rng = np.random.RandomState(0)
+    for (C, k, pad, H) in [(3, 7, 3, 32), (1, 3, 1, 28), (4, 5, 2, 63),
+                           (3, 8, 3, 64)]:
+        x = jnp.asarray(rng.randn(2, H, H, C).astype(np.float32))
+        w = jnp.asarray(rng.randn(8, k, k, C).astype(np.float32))
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "OHWI", "NHWC"))
+
+        def f_ref(x, w):
+            return lax.conv_general_dilated(
+                x, w, (2, 2), [(pad, pad), (pad, pad)],
+                dimension_numbers=dn).sum()
+
+        def f_got(x, w):
+            return _space_to_depth_conv_nhwc(x, w, (pad, pad)).sum()
+
+        ref = lax.conv_general_dilated(x, w, (2, 2), [(pad, pad), (pad, pad)],
+                                       dimension_numbers=dn)
+        got = _space_to_depth_conv_nhwc(x, w, (pad, pad))
+        assert ref.shape == got.shape
+        assert float(jnp.abs(ref - got).max()) < 1e-4
+        for a, b in zip(jax.grad(f_ref, (0, 1))(x, w),
+                        jax.grad(f_got, (0, 1))(x, w)):
+            assert float(jnp.abs(a - b).max()) < 1e-3
