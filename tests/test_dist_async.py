@@ -286,3 +286,29 @@ def test_push_pull_throughput_25m_params():
         c.close()
     finally:
         srv.shutdown()
+
+
+def test_async_four_workers_one_straggler(tmp_path):
+    """Round-5 scale-out: 4 async workers, one straggler — the three
+    fast workers finish while the straggler sleeps (no barrier at any
+    fan-in width), and every worker's updates land on the shared key."""
+    srv, (host, port) = ps_async.serve_forever()
+    try:
+        extra = {"MXNET_PS_NUM_WORKERS": "4"}
+        fast = [_spawn_worker(tmp_path, r, 20, 0.0, port, extra)
+                for r in range(3)]
+        slow = _spawn_worker(tmp_path, 3, 3, 1.5, port, extra)
+        for p in fast:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, out
+            loop = float(out.split("DONE")[1].split()[0])
+            assert loop < 4.0, (loop, out)
+        out_slow, _ = slow.communicate(timeout=180)
+        assert slow.returncode == 0, out_slow
+        assert float(out_slow.split("DONE")[1].split()[0]) >= 4.5
+        c = ps_async.AsyncPSClient((host, port), rank=9)
+        val = c.pull("w")
+        assert np.isfinite(val).all()
+        c.close()
+    finally:
+        srv.shutdown()
