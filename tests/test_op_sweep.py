@@ -131,3 +131,60 @@ def test_symbol_scalar_maximum_minimum():
     ex2 = mx.sym.minimum(0.5, a).bind(
         mx.cpu(), {"a": mx.nd.array(np.array([[0.2, 0.8]], "f"))})
     np.testing.assert_allclose(ex2.forward()[0].asnumpy(), [[0.2, 0.5]])
+
+
+# ---------------------------------------------------------------------------
+# dtype sweep: the reference op suite exercises ops across dtypes
+# (test_operator.py's check_consistency dtype lists); this sweeps the
+# dtype-generic families over ints and half-precision floats, asserting
+# BOTH values and output dtype (a silent upcast is a bug even when the
+# numbers match).
+# ---------------------------------------------------------------------------
+DTYPES = ["int32", "int64", "float16", "float64"]
+
+
+def _mk(dtype, lo=1, hi=7, shape=(3, 4), seed=3):
+    r = np.random.RandomState(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return r.randint(lo, hi, shape).astype(dtype)
+    return (r.rand(*shape) * (hi - lo) + lo).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_dtype_unary_sweep(dtype):
+    x = _mk(dtype)
+    for name, oracle in [("abs", np.abs), ("negative", np.negative),
+                         ("square", np.square), ("sign", np.sign)]:
+        out = getattr(mx.nd, name)(mx.nd.array(x, dtype=dtype))
+        assert str(out.dtype.name if hasattr(out.dtype, "name")
+                   else out.dtype) == dtype, (name, out.dtype)
+        tol = 1e-2 if dtype == "float16" else 1e-6
+        np.testing.assert_allclose(out.asnumpy().astype("f8"),
+                                   oracle(x).astype("f8"), rtol=tol)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_dtype_binary_and_reduce_sweep(dtype):
+    a, b = _mk(dtype, seed=4), _mk(dtype, seed=5)
+    for name, oracle in [("broadcast_add", np.add),
+                         ("broadcast_mul", np.multiply),
+                         ("broadcast_maximum", np.maximum),
+                         ("broadcast_minimum", np.minimum)]:
+        out = getattr(mx.nd, name)(mx.nd.array(a, dtype=dtype),
+                                   mx.nd.array(b, dtype=dtype))
+        assert np.dtype(str(out.dtype)) == np.dtype(dtype), (name, out.dtype)
+        tol = 1e-2 if dtype == "float16" else 1e-6
+        np.testing.assert_allclose(out.asnumpy().astype("f8"),
+                                   oracle(a, b).astype("f8"), rtol=tol)
+    # reductions: sum/max/min keep dtype; argmax returns f32 indices
+    # (reference convention)
+    arr = mx.nd.array(a, dtype=dtype)
+    np.testing.assert_allclose(mx.nd.sum(arr, axis=1).asnumpy()
+                               .astype("f8"),
+                               a.sum(axis=1).astype("f8"),
+                               rtol=1e-2 if dtype == "float16" else 1e-6)
+    np.testing.assert_allclose(mx.nd.max(arr, axis=0).asnumpy()
+                               .astype("f8"),
+                               a.max(axis=0).astype("f8"), rtol=1e-6)
+    am = mx.nd.argmax(arr, axis=1).asnumpy()
+    np.testing.assert_array_equal(am.astype("i8"), a.argmax(axis=1))
