@@ -25,6 +25,7 @@ from .initializer import Xavier
 from . import optimizer
 from .optimizer import Optimizer
 from . import lr_scheduler
+from . import misc  # deprecated legacy LR scheduler API (reference misc.py)
 from . import metric
 from . import symbol
 from . import symbol as sym

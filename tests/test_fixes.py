@@ -226,3 +226,17 @@ def test_create_graph_replay_uses_recorded_inputs():
     x[:] = 100.0               # mutate AFTER recording, BEFORE the replay
     gx = mx.autograd.grad(y, x, create_graph=True)
     np.testing.assert_allclose(gx.asnumpy(), [12.0], rtol=1e-6)
+
+
+def test_legacy_misc_scheduler():
+    """Deprecated mx.misc scheduler API (reference misc.py) keeps
+    working for old user code."""
+    import mxnet_tpu as mx
+    s = mx.misc.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 0.8
+    assert abs(s(0) - 0.8) < 1e-9
+    assert abs(s(10) - 0.4) < 1e-9
+    assert abs(s(25) - 0.2) < 1e-9
+    import pytest
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=0)
