@@ -46,6 +46,13 @@ def main():
     parser.add_argument("--num-steps", type=int, default=40)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--ohem", action="store_true",
+                        help="online hard example mining: rank ROI "
+                             "candidates by classification loss (scored "
+                             "with the current head, no gradient) instead "
+                             "of random sampling — exceeds the reference, "
+                             "whose ohem branch is LOG(FATAL) "
+                             "(proposal_target-inl.h:133)")
     parser.add_argument("--deformable", action="store_true",
                         help="use DeformableConvolution in the head conv "
                              "and DeformablePSROIPooling for roi features "
@@ -116,26 +123,47 @@ def main():
                 rpn_pre_nms_top_n=200, rpn_post_nms_top_n=32,
                 threshold=0.7, rpn_min_size=8)
             rois_b = rois.reshape((args.batch_size, -1, 5))
-            samp_rois, labels, bb_tgt, bb_wt = mx.nd.ProposalTarget(
-                rois_b, gt, num_classes=num_classes,
-                batch_images=args.batch_size,
-                batch_rois=args.batch_size * 16, fg_fraction=0.5,
-                fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0)
+            pt_kwargs = dict(num_classes=num_classes,
+                             batch_images=args.batch_size,
+                             batch_rois=args.batch_size * 16,
+                             fg_fraction=0.5, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0)
+            # ONE pooling path, used for both OHEM scoring and the
+            # trained head — scoring through a different feature path
+            # would rank hardness against the wrong model (and pin the
+            # deferred-shape Dense to the wrong width)
             if args.deformable:
                 offsets = offset_conv(feat)
-                feat = mx.nd.contrib.DeformableConvolution(
+                dfeat = mx.nd.contrib.DeformableConvolution(
                     feat, offsets, deform_weight.data(), kernel=(3, 3),
                     pad=(1, 1), num_filter=32, no_bias=True)
-                feat = mx.nd.relu(feat)
-                ps_feat = psroi_conv(feat)
-                pooled = mx.nd.contrib.DeformablePSROIPooling(
-                    ps_feat, samp_rois, spatial_scale=1.0 / stride,
-                    output_dim=psroi_dim, pooled_size=psroi_group,
-                    group_size=psroi_group, no_trans=True)[0]
+                ps_feat = psroi_conv(mx.nd.relu(dfeat))
+
+                def pool_fn(r):
+                    return mx.nd.contrib.DeformablePSROIPooling(
+                        ps_feat, r, spatial_scale=1.0 / stride,
+                        output_dim=psroi_dim, pooled_size=psroi_group,
+                        group_size=psroi_group, no_trans=True)[0]
             else:
-                pooled = mx.nd.ROIPooling(
-                    feat, samp_rois, pooled_size=(4, 4),
-                    spatial_scale=1.0 / stride)
+                def pool_fn(r):
+                    return mx.nd.ROIPooling(
+                        feat, r, pooled_size=(4, 4),
+                        spatial_scale=1.0 / stride)
+            if args.ohem:
+                # score EVERY candidate with the current head (no
+                # gradient) so ProposalTarget can keep the hardest rois
+                with autograd.pause():
+                    pooled_all = pool_fn(rois)
+                    logits_all = rcnn_cls(rcnn_fc(
+                        pooled_all.reshape((pooled_all.shape[0], -1))))
+                    prob_b = mx.nd.softmax(logits_all, axis=-1).reshape(
+                        (args.batch_size, -1, num_classes))
+                samp_rois, labels, bb_tgt, bb_wt = mx.nd.ProposalTarget(
+                    rois_b, gt, prob_b, ohem=True, **pt_kwargs)
+            else:
+                samp_rois, labels, bb_tgt, bb_wt = mx.nd.ProposalTarget(
+                    rois_b, gt, **pt_kwargs)
+            pooled = pool_fn(samp_rois)
             hid = rcnn_fc(pooled.reshape((pooled.shape[0], -1)))
             cls_logits = rcnn_cls(hid)
             bbox_pred = rcnn_bbox(hid)

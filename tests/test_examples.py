@@ -108,6 +108,24 @@ def test_deformable_rcnn():
     assert "FASTER-RCNN FLOW OK" in r.stdout
 
 
+def test_faster_rcnn_ohem():
+    """Hardest-first ROI sampling (round 5; the reference LOG(FATAL)s
+    on ohem=True — proposal_target-inl.h:133)."""
+    r = _run("rcnn/train_faster_rcnn.py", "--num-steps", "15", "--ohem")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FASTER-RCNN FLOW OK" in r.stdout
+
+
+def test_faster_rcnn_ohem_deformable():
+    """OHEM scoring must ride the SAME pooling path the deformable head
+    trains on (a separate ROIPooling scoring pass pinned the deferred
+    Dense to the wrong width — review-caught crash)."""
+    r = _run("rcnn/train_faster_rcnn.py", "--num-steps", "10", "--ohem",
+             "--deformable")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FASTER-RCNN FLOW OK" in r.stdout
+
+
 def test_adversary_fgsm():
     r = _run("adversary/fgsm_mnist.py", "--num-examples", "600",
              "--num-epochs", "3")
