@@ -5,8 +5,9 @@ import logging
 import math
 import time
 
-__all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
-           "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
+__all__ = ["module_checkpoint", "do_checkpoint", "elastic_checkpoint",
+           "log_train_metric", "Speedometer", "ProgressBar",
+           "LogValidationMetricsCallback"]
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
@@ -26,6 +27,22 @@ def do_checkpoint(prefix, period=1):
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    return _callback
+
+
+def elastic_checkpoint(manager, mod, period=1):
+    """Epoch-end callback backing ``fit(elastic=...)``: a sharded,
+    commit-marked, rotated checkpoint of the module's parameters via an
+    `parallel.elastic.ElasticCheckpointer` — unlike `module_checkpoint`
+    (single-host ``.params`` files) this is the multi-host form a
+    preempted pod resumes from, and a write interrupted mid-checkpoint is
+    never restored (no COMMIT marker)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            from .parallel import elastic as _elastic
+            _elastic.save_module(manager, iter_no + 1, mod)
     return _callback
 
 

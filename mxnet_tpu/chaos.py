@@ -1,0 +1,213 @@
+"""Deterministic fault injection (chaos) layer.
+
+Recovery code is only trustworthy if every failure it guards against can
+be triggered on demand: a dead worker, a coordinator that times out, a
+heartbeat that arrives late, a checkpoint write cut off before the commit
+marker lands. This module is a registry of named *sites* that production
+code polls at its failure points; a site stays silent until armed, so the
+hooks cost one dict lookup on the happy path and nothing is injected in
+normal runs.
+
+Arming is deterministic (fire on the Nth poll, M times), never random —
+the same arming always reproduces the same failure, in-process
+(:func:`arm` / :func:`armed`) or across subprocess boundaries via the
+``MXNET_CHAOS`` env var, so a supervisor can arm a launched worker::
+
+    MXNET_CHAOS="worker.death@6"            # die on the 7th poll
+    MXNET_CHAOS="coordinator.timeout@0x2"   # first 2 polls time out
+    MXNET_CHAOS="heartbeat.delay@3x2=1.5"   # polls 4-5 stall 1.5s
+
+Spec grammar: ``site[@after][xN][=value]`` — skip ``after`` polls, then
+fire ``N`` times (default 1) carrying ``value``; comma-separated entries.
+
+The reference framework has no equivalent — its ps-lite failure handling
+was exercised only by real node loss; here every recovery path in
+`parallel/elastic.py`, `parallel/dist.py`, and `parallel/checkpoint.py`
+is testable in-process and in launched multi-process tests.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from contextlib import contextmanager
+
+__all__ = ["arm", "armed", "arm_from_env", "clear", "fire", "fired",
+           "is_armed", "ChaosError", "ChaosTimeout", "ChaosInterrupt",
+           "maybe_timeout", "maybe_die", "maybe_interrupt_checkpoint",
+           "maybe_step_fail", "heartbeat_extra_delay", "SITES",
+           "DEAD_EXIT_CODE"]
+
+SITES = {
+    "coordinator.timeout": "ChaosTimeout from coordinator KV ops, "
+                           "barrier, and dist.init",
+    "heartbeat.delay": "stall the heartbeat writer by VALUE seconds "
+                       "(default 1.0)",
+    "worker.death": "os._exit(VALUE, default 17) at the elastic step "
+                    "boundary — a crashed worker, no cleanup",
+    "checkpoint.interrupt": "ChaosInterrupt after checkpoint data is "
+                            "written but before the commit marker — a "
+                            "torn checkpoint",
+    "step.fail": "ChaosError from inside the training step",
+}
+
+#: exit code used by an injected worker death (distinct from the elastic
+#: watchdog's RESTART_EXIT_CODE so logs tell the two apart)
+DEAD_EXIT_CODE = 17
+
+
+class ChaosError(RuntimeError):
+    """Base class for injected failures."""
+
+
+class ChaosTimeout(ChaosError, TimeoutError):
+    """Injected coordinator timeout (retryable transient)."""
+
+
+class ChaosInterrupt(ChaosError):
+    """Injected interruption of a checkpoint write."""
+
+
+class _Trigger:
+    __slots__ = ("site", "after", "times", "value", "calls", "hits")
+
+    def __init__(self, site, after=0, times=1, value=None):
+        self.site = site
+        self.after = int(after)
+        self.times = int(times)
+        self.value = value
+        self.calls = 0
+        self.hits = 0
+
+    def poll(self):
+        self.calls += 1
+        if self.calls > self.after and self.hits < self.times:
+            self.hits += 1
+            return True
+        return False
+
+
+_lock = threading.Lock()
+_triggers = {}  # site -> [_Trigger]
+_fired = {}     # site -> total injections
+
+_SPEC_RE = re.compile(
+    r"^(?P<site>[a-z_.]+)(?:@(?P<after>\d+))?(?:x(?P<times>\d+))?"
+    r"(?:=(?P<value>.+))?$")
+
+
+def _check_site(site):
+    if site not in SITES:
+        raise ValueError("unknown chaos site %r (known: %s)"
+                         % (site, ", ".join(sorted(SITES))))
+
+
+def arm(site, after=0, times=1, value=None):
+    """Arm ``site`` to fire on polls ``after+1 .. after+times``."""
+    _check_site(site)
+    trig = _Trigger(site, after=after, times=times, value=value)
+    with _lock:
+        _triggers.setdefault(site, []).append(trig)
+    return trig
+
+
+def arm_from_env(spec=None):
+    """Parse an ``MXNET_CHAOS``-style spec string and arm each entry.
+    Called once at import so subprocesses armed via env need no code."""
+    spec = os.environ.get("MXNET_CHAOS", "") if spec is None else spec
+    for entry in filter(None, (s.strip() for s in spec.split(","))):
+        m = _SPEC_RE.match(entry)
+        if m is None:
+            raise ValueError("bad MXNET_CHAOS entry %r "
+                             "(want site[@after][xN][=value])" % entry)
+        arm(m.group("site"), after=int(m.group("after") or 0),
+            times=int(m.group("times") or 1), value=m.group("value"))
+
+
+def clear(site=None):
+    """Disarm ``site`` (or every site) and reset fired counters."""
+    with _lock:
+        if site is None:
+            _triggers.clear()
+            _fired.clear()
+        else:
+            _triggers.pop(site, None)
+            _fired.pop(site, None)
+
+
+def is_armed(site):
+    with _lock:
+        return any(t.hits < t.times for t in _triggers.get(site, ()))
+
+
+def fired(site):
+    """How many times ``site`` actually injected (for test assertions)."""
+    with _lock:
+        return _fired.get(site, 0)
+
+
+def fire(site):
+    """Poll an injection point. Returns ``None`` when nothing injects;
+    otherwise the armed value (``True`` when no value was given)."""
+    _check_site(site)
+    with _lock:
+        for trig in _triggers.get(site, ()):
+            if trig.poll():
+                _fired[site] = _fired.get(site, 0) + 1
+                logging.warning("chaos: firing %s (hit %d/%d, value=%r)",
+                                site, trig.hits, trig.times, trig.value)
+                return True if trig.value is None else trig.value
+    return None
+
+
+@contextmanager
+def armed(site, after=0, times=1, value=None):
+    """Context manager: arm for the block, disarm that trigger on exit."""
+    trig = arm(site, after=after, times=times, value=value)
+    try:
+        yield trig
+    finally:
+        with _lock:
+            lst = _triggers.get(site, [])
+            if trig in lst:
+                lst.remove(trig)
+
+
+# -- convenience raisers for the standard sites -----------------------------
+
+def maybe_timeout(where=""):
+    if fire("coordinator.timeout") is not None:
+        raise ChaosTimeout("chaos: injected coordinator timeout%s"
+                           % (" (%s)" % where if where else ""))
+
+
+def maybe_die():
+    val = fire("worker.death")
+    if val is not None:
+        code = DEAD_EXIT_CODE if val is True else int(val)
+        logging.warning("chaos: worker death, os._exit(%d)", code)
+        os._exit(code)
+
+
+def maybe_interrupt_checkpoint(path=""):
+    if fire("checkpoint.interrupt") is not None:
+        raise ChaosInterrupt(
+            "chaos: checkpoint write interrupted before commit marker%s"
+            % (" at %s" % path if path else ""))
+
+
+def maybe_step_fail(step=None):
+    if fire("step.fail") is not None:
+        raise ChaosError("chaos: injected step failure%s"
+                         % ("" if step is None else " at step %s" % step))
+
+
+def heartbeat_extra_delay():
+    val = fire("heartbeat.delay")
+    if val is None:
+        return 0.0
+    return 1.0 if val is True else float(val)
+
+
+arm_from_env()

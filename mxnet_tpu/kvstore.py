@@ -65,6 +65,8 @@ class KVStore:
         self._compression = {}
         self._gc = None
         self._fused = None  # lazily resolved FusedApplier (or False)
+        self._barrier_policy = None  # lazily built retry policy
+        self._last_barrier_attempts = 0
 
     # -- identity --------------------------------------------------------
     @property
@@ -276,18 +278,60 @@ class KVStore:
 
     def barrier(self):
         if self.num_workers > 1:
-            from .parallel import dist
-            dist.barrier()
+            self._barrier_with_retry()
+
+    def _barrier_with_retry(self):
+        """Barrier through the retry layer: a coordinator that times out
+        (preemption, restart) is backed off and retried with jitter
+        instead of killing the run. ``_last_barrier_attempts`` records
+        how many tries the last barrier took (1 = clean).
+
+        Retry is deliberately restricted to timeout-like failures
+        (TimeoutError, coordination-service DEADLINE_EXCEEDED /
+        UNAVAILABLE), which in practice fail before peers are released.
+        A generic mid-collective error may be asymmetric — one rank
+        retrying a barrier its peers already passed would leave the
+        ranks' collective counts permanently offset — so anything else
+        propagates to the elastic layer, whose answer is
+        abort-and-recover, not re-invocation. Residual risk: the
+        transport is a device collective, so even a timeout CAN in
+        principle be asymmetric (one rank's contribution released peers
+        before its own deadline fired); runs that cannot tolerate a
+        one-barrier offset should set MXNET_BARRIER_MAX_ATTEMPTS=1 and
+        rely on elastic recovery instead."""
+        from .parallel import dist, retry
+        if self._barrier_policy is None:
+            self._barrier_policy = retry.RetryPolicy.from_env(
+                "MXNET_BARRIER", max_attempts=4, base_delay=0.2,
+                max_delay=5.0)
+        try:
+            retry.retry_call(dist.barrier, policy=self._barrier_policy,
+                             retry_on=retry.timeout_like,
+                             describe="kvstore barrier")
+        finally:
+            # record the attempt count on failure too — that's exactly
+            # when a caller inspects it
+            self._last_barrier_attempts = \
+                self._barrier_policy.last_attempts
 
     def send_command_to_servers(self, head, body):
         """PS command channel; server-free on TPU — no-op for parity."""
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Failure detection (reference kvstore.h:338 backed by ps-lite
-        heartbeats, van.cc). Multi-process stores count peers whose
-        heartbeat in the jax.distributed coordinator KV store is older
-        than ``timeout`` (see `parallel/dist.py:num_dead_nodes`);
-        single-process stores report 0."""
+        heartbeats, van.cc): count peers whose heartbeat is older than
+        ``timeout`` seconds. ``node_id`` is accepted for reference-API
+        parity only — the reference scoped the query to one node's view;
+        both backends here count stale peers globally, so the argument is
+        ignored. One implementation serves every store type: subclasses
+        override only the :meth:`_count_dead_nodes` transport."""
+        del node_id  # parity-only, see docstring
+        return self._count_dead_nodes(timeout)
+
+    def _count_dead_nodes(self, timeout):
+        """Transport hook: coordinator-KV heartbeats for dist stores
+        (`parallel/dist.py:num_dead_nodes`); single-process stores have
+        no peers to lose."""
         if self.type.startswith("dist"):
             from .parallel import dist
             return dist.num_dead_nodes(timeout)
@@ -375,8 +419,10 @@ class AsyncKVStore(KVStore):
         self._optimizer = optimizer
         self._client.set_optimizer(optimizer)
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
-        return self._client.num_dead_node(node_id, timeout)
+    def _count_dead_nodes(self, timeout):
+        # same contract as the base (node_id already stripped there):
+        # the PS tracks per-rank heartbeats server-side
+        return self._client.num_dead_node(0, timeout)
 
     def barrier(self):
         """Async mode has no training barrier; kept as heartbeat ping."""

@@ -154,14 +154,21 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, batches_per_dispatch=1,
-            scan_unroll=None):
+            scan_unroll=None, elastic=None):
         """Reference base_module.py:395 training loop.
 
         TPU extension: ``batches_per_dispatch=K`` groups K batches into ONE
         device dispatch (`Module._step_scan`: the batches are staged to the
         device and a lax.scan carries params/optimizer state through the K
         fused train steps). Metrics and batch callbacks still fire per
-        batch, from the scan's stacked per-step outputs."""
+        batch, from the scan's stacked per-step outputs.
+
+        Elastic extension: ``elastic=`` (a checkpoint directory path, or a
+        dict ``{"path": ..., "period": epochs, "keep_last": N}``) makes the
+        run preemption-safe via `parallel/elastic.py`: parameters are
+        checkpointed (sharded, commit-marked, rotated) every ``period``
+        epochs, and a restarted run resumes from the latest complete
+        checkpoint — ``begin_epoch`` fast-forwards past finished epochs."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -182,6 +189,32 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+
+        if elastic is not None:
+            from ..parallel import elastic as elastic_mod
+            from .. import callback as callback_mod
+            cfg = {"path": elastic} if isinstance(elastic, str) \
+                else dict(elastic)
+            known = {"path", "period", "keep_last", "backend",
+                     "commit_timeout"}
+            unknown = set(cfg) - known
+            if unknown or "path" not in cfg:
+                raise ValueError(
+                    "fit(elastic=...) options are %s (got %s)"
+                    % (sorted(known), sorted(cfg)))
+            ckpt = elastic_mod.ElasticCheckpointer(
+                cfg["path"], keep_last=cfg.get("keep_last", 3),
+                backend=cfg.get("backend", "auto"),
+                commit_timeout=cfg.get("commit_timeout"))
+            resumed = elastic_mod.restore_module(ckpt, self)
+            if resumed is not None:
+                # checkpoint step == number of completed epochs
+                begin_epoch = max(begin_epoch, resumed)
+                self.logger.info("elastic: resumed from checkpoint; "
+                                 "starting at epoch %d", begin_epoch)
+            epoch_end_callback = list(_as_list(epoch_end_callback)) + [
+                callback_mod.elastic_checkpoint(
+                    ckpt, self, period=cfg.get("period", 1))]
 
         use_scan = batches_per_dispatch > 1 and monitor is None and \
             hasattr(self, "_step_scan")

@@ -14,6 +14,11 @@ from .ring_attention import (ring_attention, ulysses_attention,
 from .pipeline import pipeline_apply, stack_stage_params, PipelineTrainStep
 from .moe import moe_apply, stack_expert_params, MoETrainStep
 from .checkpoint import save_sharded, load_sharded, abstract_like
+from . import retry
+from .retry import RetryPolicy, RetryError, retry_call
+from . import elastic
+from .elastic import (ElasticCheckpointer, ElasticTrainer, run_elastic,
+                      supervise)
 
 __all__ = ["pipeline_apply", "stack_stage_params", "moe_apply", "stack_expert_params",
            "MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
@@ -21,5 +26,8 @@ __all__ = ["pipeline_apply", "stack_stage_params", "moe_apply", "stack_expert_pa
            "PipelineTrainStep", "MoETrainStep", "sgd_update",
            "split_and_load_sharded",
            "save_sharded", "load_sharded", "abstract_like",
+           "retry", "RetryPolicy", "RetryError", "retry_call",
+           "elastic", "ElasticCheckpointer", "ElasticTrainer",
+           "run_elastic", "supervise",
            "ring_attention", "ulysses_attention", "local_attention",
            "sequence_sharding"]

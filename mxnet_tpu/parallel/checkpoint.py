@@ -17,7 +17,26 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["save_sharded", "load_sharded", "abstract_like"]
+__all__ = ["save_sharded", "load_sharded", "abstract_like", "COMMIT_FILE"]
+
+#: name of the commit-marker file the elastic checkpointer drops NEXT TO
+#: the orbax payload once every host has durably written its shards; a
+#: step directory without it is torn and must never be restored
+COMMIT_FILE = "COMMIT"
+
+
+def _commit_marker_state(path):
+    """'present'/'absent' for the commit marker governing ``path`` (inside
+    the checkpoint dir or beside it in the parent step dir), or
+    'not applicable' when neither location has ever been marked."""
+    parent = os.path.dirname(os.path.abspath(path))
+    for marker in (os.path.join(path, COMMIT_FILE),
+                   os.path.join(parent, COMMIT_FILE)):
+        if os.path.exists(marker):
+            return "present"
+    if os.path.basename(os.path.abspath(path)) == "state":
+        return "absent"  # elastic layout: step_N/state + step_N/COMMIT
+    return "not applicable"
 
 
 def _unwrap(tree):
@@ -74,8 +93,25 @@ def load_sharded(path, template):
     """Restore a checkpoint onto the placements described by
     ``template`` (from :func:`abstract_like`, or any pytree of
     ShapeDtypeStructs carrying shardings). Resharding is allowed: the
-    checkpoint may have been written from a different mesh."""
+    checkpoint may have been written from a different mesh.
+
+    Raises FileNotFoundError when ``path`` does not exist, and ValueError
+    when it exists but is not a restorable checkpoint (torn write,
+    foreign directory) — both name the path and the commit-marker state
+    instead of surfacing a raw orbax traceback."""
     import orbax.checkpoint as ocp
 
-    with ocp.StandardCheckpointer() as ck:
-        return ck.restore(os.path.abspath(path), template)
+    apath = os.path.abspath(path)
+    if not os.path.exists(apath):
+        raise FileNotFoundError(
+            "sharded checkpoint not found: %s (commit marker: %s)"
+            % (apath, _commit_marker_state(apath)))
+    try:
+        with ocp.StandardCheckpointer() as ck:
+            return ck.restore(apath, template)
+    except Exception as exc:
+        raise ValueError(
+            "sharded checkpoint at %s exists but cannot be restored "
+            "(commit marker: %s) — likely an interrupted write; pick the "
+            "latest COMMIT-marked step instead. Underlying error: %s"
+            % (apath, _commit_marker_state(apath), exc)) from exc

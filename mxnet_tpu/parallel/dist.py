@@ -23,9 +23,9 @@ import os
 import numpy as np
 import jax
 
-__all__ = ["init", "allreduce_nd", "allreduce_nds", "broadcast_nd",
-           "barrier", "rank", "size", "start_heartbeat", "stop_heartbeat",
-           "num_dead_nodes"]
+__all__ = ["init", "shutdown", "allreduce_nd", "allreduce_nds",
+           "broadcast_nd", "barrier", "rank", "size", "start_heartbeat",
+           "stop_heartbeat", "num_dead_nodes"]
 
 _initialized = False
 _PMESH = None
@@ -58,12 +58,35 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
     if recoverable is None:
         recoverable = os.environ.get("MXNET_RECOVERABLE", "0") == "1"
     if coordinator_address:
-        if recoverable:
-            _init_recoverable(coordinator_address, num_processes,
-                              process_id)
-        else:
-            jax.distributed.initialize(coordinator_address, num_processes,
-                                       process_id)
+        # coordinator attach is the classic transient: workers race the
+        # coordinator process coming up, and a preempted coordinator
+        # returns timeouts for a while before recovering — retry with
+        # bounded backoff instead of dying on the first connect
+        from . import retry as _retry
+        from .. import chaos
+
+        def _attach():
+            chaos.maybe_timeout("dist.init")
+            try:
+                if recoverable:
+                    _init_recoverable(coordinator_address, num_processes,
+                                      process_id)
+                else:
+                    jax.distributed.initialize(coordinator_address,
+                                               num_processes, process_id)
+            except Exception:
+                # a failed connect leaves jax's global state partially
+                # initialized (client/service assigned BEFORE connect),
+                # and a second initialize would then raise 'should only
+                # be called once' — clear it so the retry really retries
+                _clear_jax_distributed_state()
+                raise
+
+        _retry.retry_call(
+            _attach, policy=_retry.RetryPolicy.from_env(
+                "MXNET_INIT", max_attempts=4, base_delay=0.5, max_delay=10.0),
+            retry_on=_retry.timeout_like,  # config errors must fail fast
+            describe="jax.distributed.initialize")
     _initialized = True
     # liveness protocol on by default for multi-process runs (reference
     # ps-lite heartbeats are always on, van.cc); cheap: one tiny KV write
@@ -106,6 +129,48 @@ def _init_recoverable(coordinator_address, num_processes, process_id):
                                    process_id)
     finally:
         _jaxlib.get_distributed_runtime_client = orig
+
+
+def _clear_jax_distributed_state():
+    """Best-effort reset of jax's distributed global state so a failed or
+    torn-down attach doesn't poison the next ``initialize`` call."""
+    try:
+        from jax._src import distributed as _jd
+        state = _jd.global_state
+    except Exception:  # pragma: no cover - internal layout moved
+        return
+    for attr in ("client", "service", "preemption_sync_manager"):
+        obj = getattr(state, attr, None)
+        if obj is not None:
+            try:
+                obj.shutdown()
+            except Exception:
+                pass
+            try:
+                setattr(state, attr, None)
+            except Exception:  # pragma: no cover
+                pass
+
+
+def shutdown():
+    """Tear down multi-process state so :func:`init` can attach again —
+    the elastic restart path (reference analog: a ps-lite worker
+    re-registering with the scheduler after a restart). Stops the
+    heartbeat writer, disconnects from the coordinator, and drops every
+    cache keyed on the old device set (process mesh, jitted collectives,
+    data-parallel meshes) so the rebuilt cluster gets fresh ones."""
+    global _initialized, _PMESH
+    stop_heartbeat()
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # not initialized / coordinator already gone
+        pass
+    _clear_jax_distributed_state()  # a half-failed shutdown must not
+    _initialized = False            # block the next initialize
+    _PMESH = None
+    _AR_JIT.clear()
+    from . import mesh as _mesh
+    _mesh._DP_MESHES.clear()
 
 
 def rank():
@@ -227,10 +292,34 @@ def broadcast_nd(nd):
 
 
 def barrier():
+    from .. import chaos
+    chaos.maybe_timeout("barrier")  # armed chaos applies at any size
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
+
+
+def host_barrier(name, timeout_s=60.0):
+    """Barrier over the coordination service itself — pure host-side, so
+    it works even where device collectives are unavailable (multiprocess
+    CPU test clusters) and never dispatches to the accelerator. Used for
+    control-plane gates like the elastic checkpoint commit. ``name`` must
+    be unique per use within one coordinator's lifetime."""
+    from .. import chaos
+    chaos.maybe_timeout("host_barrier")
+    if jax.process_count() == 1:
+        return
+    client = _coordinator_client()
+    if client is None:
+        # multi-process but no coordination client: the gate CANNOT be
+        # provided, and callers (the elastic commit) rely on it for
+        # correctness — fail loudly instead of silently passing
+        raise RuntimeError(
+            "host_barrier(%r): no coordination-service client available "
+            "in a %d-process run; cannot synchronize hosts"
+            % (name, jax.process_count()))
+    client.wait_at_barrier(name, int(timeout_s * 1000))
 
 
 # ---------------------------------------------------------------------------
@@ -264,8 +353,13 @@ def start_heartbeat(interval=5.0):
     _HB_STOP = stop_evt           # pair must not hand the old thread the
     me = jax.process_index()      # new thread's event (it would never stop)
 
+    from .. import chaos
+
     def beat():
         while True:
+            extra = chaos.heartbeat_extra_delay()
+            if extra:  # injected network stall: the beat arrives late
+                _time.sleep(extra)
             try:
                 client.key_value_set("%s/%d" % (_HB_PREFIX, me),
                                      repr(_time.time()),
@@ -284,7 +378,10 @@ def start_heartbeat(interval=5.0):
 def stop_heartbeat():
     """Stop the liveness writer and WAIT for it: after return, no further
     heartbeat reaches the coordinator (so a stopped node goes stale and
-    num_dead_nodes counts it)."""
+    num_dead_nodes counts it). Returns True on a clean stop (or when no
+    writer was running); False — with a warning — if the thread failed to
+    exit within 30s and was leaked (e.g. a KV write wedged on a dead
+    coordinator), in which case a stray late beat may still land."""
     global _HB_THREAD, _HB_STOP
     thread, _HB_THREAD = _HB_THREAD, None
     if _HB_STOP is not None:
@@ -292,12 +389,28 @@ def stop_heartbeat():
     _HB_STOP = None
     if thread is not None:
         thread.join(timeout=30)
+        if thread.is_alive():
+            import logging
+            logging.warning(
+                "heartbeat writer did not stop within 30s; leaking the "
+                "thread (a late beat may still reach the coordinator)")
+            return False
+    return True
 
 
 def num_dead_nodes(timeout=60):
     """Count processes whose heartbeat is older than ``timeout`` seconds
     (or missing entirely). Returns 0 when not distributed or when no peer
     ever started heartbeating (no liveness protocol in play)."""
+    from .. import chaos
+    chaos.maybe_timeout("num_dead_nodes")  # armed chaos applies at any size
+    return _num_dead_nodes_nochaos(timeout)
+
+
+def _num_dead_nodes_nochaos(timeout):
+    """num_dead_nodes without the chaos poll — for background monitors
+    (the elastic watchdog) whose own polling would otherwise race the
+    main thread for armed triggers and break chaos determinism."""
     client = _coordinator_client()
     if client is None or jax.process_count() == 1:
         return 0

@@ -1,0 +1,124 @@
+"""Bounded exponential backoff with jitter, shared by every transient-
+failure path: ``dist.init`` (coordinator not up yet / injected timeout),
+coordinator KV ops, ``KVStore.barrier``, and the elastic supervisor's
+restart loop.
+
+The reference framework leans on ps-lite's van-level resends; here the
+coordinator is the jax.distributed service, whose client surfaces
+transients as exceptions — so the retry lives in Python, one policy
+object per call site. Delays grow ``base * multiplier**k`` capped at
+``max_delay``, then shrink by up to ``jitter`` fraction (decorrelates a
+pod's worth of workers all retrying the same dead coordinator at once).
+
+``_sleep`` is a module attribute so tests can capture delays instead of
+sleeping.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import time
+
+__all__ = ["RetryPolicy", "RetryError", "retry_call", "timeout_like"]
+
+_sleep = time.sleep  # monkeypatch point for tests
+
+
+def timeout_like(exc):
+    """True for failures safe to treat as 'timed out before taking
+    effect': TimeoutError (including injected ChaosTimeout) and the
+    coordination service's DEADLINE_EXCEEDED / UNAVAILABLE RPC errors,
+    which jax surfaces as XlaRuntimeError rather than TimeoutError.
+    Usable as a ``retry_on`` predicate."""
+    if isinstance(exc, TimeoutError):
+        return True
+    msg = str(exc)
+    return type(exc).__name__ == "XlaRuntimeError" and (
+        "DEADLINE_EXCEEDED" in msg or "UNAVAILABLE" in msg)
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted; ``__cause__`` is the last failure."""
+
+    def __init__(self, message, attempts):
+        super().__init__(message)
+        self.attempts = attempts
+
+
+class RetryPolicy:
+    """max_attempts total tries; delay before retry k (1-based) is
+    ``min(max_delay, base_delay * multiplier**(k-1))`` scaled by a
+    uniform factor in ``[1 - jitter, 1]``."""
+
+    def __init__(self, max_attempts=5, base_delay=0.5, max_delay=30.0,
+                 multiplier=2.0, jitter=0.5, retry_on=(Exception,),
+                 seed=None):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.retry_on = tuple(retry_on)
+        self._rng = random.Random(seed)
+        self.last_attempts = 0  # attempts used by the most recent call
+
+    def delay_for(self, attempt):
+        """Backoff before retrying after failed attempt ``attempt``."""
+        base = min(self.max_delay,
+                   self.base_delay * self.multiplier ** (attempt - 1))
+        return base * (1.0 - self.jitter * self._rng.random())
+
+    @classmethod
+    def from_env(cls, prefix, **defaults):
+        """Policy overridable via ``<PREFIX>_MAX_ATTEMPTS`` /
+        ``<PREFIX>_BASE_DELAY`` / ``<PREFIX>_MAX_DELAY`` env vars."""
+        import os
+        kw = dict(defaults)
+        for name, cast in (("max_attempts", int), ("base_delay", float),
+                           ("max_delay", float)):
+            env = os.environ.get("%s_%s" % (prefix, name.upper()))
+            if env is not None:
+                kw[name] = cast(env)
+        return cls(**kw)
+
+
+def retry_call(fn, *args, policy=None, retry_on=None, describe=None,
+               on_retry=None, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures under
+    ``policy``. ``retry_on`` is a tuple of exception classes or a
+    predicate ``exc -> bool`` (e.g. :func:`timeout_like`). Sets
+    ``policy.last_attempts`` so call sites can assert or report how many
+    tries a success took; raises :class:`RetryError` (chaining the last
+    failure) once attempts are exhausted."""
+    policy = policy or RetryPolicy()
+    if retry_on is None:
+        retry_on = policy.retry_on
+    elif isinstance(retry_on, type):
+        retry_on = (retry_on,)
+    describe = describe or getattr(fn, "__name__", "call")
+    attempt = 0
+    while True:
+        attempt += 1
+        policy.last_attempts = attempt
+        try:
+            return fn(*args, **kwargs)
+        except Exception as exc:
+            retryable = retry_on(exc) if callable(retry_on) \
+                else isinstance(exc, retry_on)
+            if not retryable:
+                raise
+            if attempt >= policy.max_attempts:
+                raise RetryError(
+                    "%s failed after %d attempts: %s"
+                    % (describe, attempt, exc), attempt) from exc
+            delay = policy.delay_for(attempt)
+            logging.warning("%s failed (attempt %d/%d): %s — retrying in "
+                            "%.2fs", describe, attempt, policy.max_attempts,
+                            exc, delay)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            _sleep(delay)

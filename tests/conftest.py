@@ -47,6 +47,15 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: smoke tests that run on the real TPU chip "
         "(enabled with MXNET_TEST_TPU=1, select with -m tpu)")
+    config.addinivalue_line(
+        "markers", "launched: spawns multi-process worker subprocesses "
+        "(coordinator/PS/elastic tests); all subprocess waits go through "
+        "tests/launchutil.py with explicit timeouts so a hung coordinator "
+        "can never wedge the tier-1 lane; deselect with -m 'not launched'")
+    config.addinivalue_line(
+        "markers", "timeout(seconds): documented wall-clock budget of a "
+        "launched test; enforcement is the subprocess timeouts inside "
+        "(tests/launchutil.py), not a runner plugin")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -65,3 +74,11 @@ def _seed():
     import mxnet_tpu as mx
     mx.random.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarm():
+    """No chaos trigger armed in one test may leak into the next."""
+    yield
+    from mxnet_tpu import chaos
+    chaos.clear()

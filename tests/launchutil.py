@@ -1,0 +1,53 @@
+"""Shared harness for ``launched`` (multi-process subprocess) tests.
+
+Every wait on a launched worker goes through here so a hung coordinator,
+wedged collective, or dead PS can never hold a communicate() forever and
+wedge the tier-1 lane: on expiry the subprocess tree member is killed and
+the test fails with whatever output was captured. The per-test budget is
+``MXNET_TEST_LAUNCH_TIMEOUT`` (seconds, default 150).
+"""
+import os
+import subprocess
+
+LAUNCH_TIMEOUT = float(os.environ.get("MXNET_TEST_LAUNCH_TIMEOUT", "150"))
+
+
+def free_port():
+    """An OS-assigned free TCP port for a test coordinator/PS."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def communicate(proc, timeout=LAUNCH_TIMEOUT):
+    """``proc.communicate`` that kills the process on expiry instead of
+    wedging the lane; fails the test with the partial output."""
+    try:
+        return proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        raise AssertionError(
+            "launched subprocess exceeded %.0fs and was killed.\n"
+            "--- stdout ---\n%s\n--- stderr ---\n%s"
+            % (timeout, out, err))
+
+
+def communicate_all(procs, timeout=LAUNCH_TIMEOUT):
+    """Collect (out, err) from every proc under ONE shared deadline;
+    kills every straggler (and still-running peers) on expiry."""
+    import time
+    deadline = time.monotonic() + timeout
+    results = []
+    try:
+        for p in procs:
+            left = max(1.0, deadline - time.monotonic())
+            results.append(communicate(p, timeout=left))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results

@@ -1,0 +1,90 @@
+"""Fault-injection registry (`mxnet_tpu/chaos.py`): deterministic
+arming (Nth-poll triggers, counts), env-spec parsing for launched
+workers, and the site hooks production code polls."""
+import pytest
+
+from mxnet_tpu import chaos
+
+
+def test_unarmed_site_is_silent():
+    assert chaos.fire("coordinator.timeout") is None
+    assert chaos.fired("coordinator.timeout") == 0
+    chaos.maybe_timeout("nothing armed")  # no raise
+
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        chaos.arm("made.up")
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        chaos.fire("made.up")
+
+
+def test_deterministic_after_and_times():
+    chaos.arm("step.fail", after=2, times=2)
+    fires = [chaos.fire("step.fail") is not None for _ in range(6)]
+    # polls 1-2 pass, 3-4 fire, 5-6 pass again (times exhausted)
+    assert fires == [False, False, True, True, False, False]
+    assert chaos.fired("step.fail") == 2
+
+
+def test_value_payload_carried():
+    chaos.arm("heartbeat.delay", value=2.5)
+    assert chaos.heartbeat_extra_delay() == 2.5
+    assert chaos.heartbeat_extra_delay() == 0.0  # disarmed after one hit
+
+
+def test_armed_context_manager_disarms():
+    with chaos.armed("coordinator.timeout", times=100):
+        assert chaos.is_armed("coordinator.timeout")
+        with pytest.raises(chaos.ChaosTimeout):
+            chaos.maybe_timeout()
+    assert not chaos.is_armed("coordinator.timeout")
+    chaos.maybe_timeout()  # silent again
+
+
+def test_env_spec_parsing():
+    chaos.arm_from_env("step.fail@1x2, coordinator.timeout, "
+                       "heartbeat.delay@0x1=1.5")
+    assert chaos.is_armed("step.fail")
+    assert chaos.is_armed("coordinator.timeout")
+    assert chaos.heartbeat_extra_delay() == 1.5
+    assert chaos.fire("step.fail") is None  # after=1: first poll passes
+    assert chaos.fire("step.fail") is True
+    assert chaos.fire("step.fail") is True
+    assert chaos.fire("step.fail") is None  # x2 exhausted
+    with pytest.raises(chaos.ChaosTimeout):
+        chaos.maybe_timeout()
+
+
+def test_env_spec_bad_entry_rejected():
+    with pytest.raises(ValueError, match="bad MXNET_CHAOS entry"):
+        chaos.arm_from_env("step.fail@@5")
+
+
+def test_clear_single_site():
+    chaos.arm("step.fail", times=10)
+    chaos.arm("coordinator.timeout", times=10)
+    chaos.clear("step.fail")
+    assert not chaos.is_armed("step.fail")
+    assert chaos.is_armed("coordinator.timeout")
+
+
+def test_step_fail_raiser_names_step():
+    chaos.arm("step.fail")
+    with pytest.raises(chaos.ChaosError, match="step 42"):
+        chaos.maybe_step_fail(42)
+
+
+def test_checkpoint_interrupt_raiser():
+    chaos.arm("checkpoint.interrupt")
+    with pytest.raises(chaos.ChaosInterrupt, match="/tmp/ck"):
+        chaos.maybe_interrupt_checkpoint("/tmp/ck")
+
+
+def test_heartbeat_delay_injection_in_dist_writer():
+    """The dist heartbeat thread polls heartbeat.delay each beat; armed
+    delay stalls the write (observable: the poll consumes the trigger)."""
+    from mxnet_tpu.parallel import dist  # noqa: F401  (site lives there)
+    chaos.arm("heartbeat.delay", value=0.0)
+    assert chaos.heartbeat_extra_delay() == 0.0
+    assert chaos.fired("heartbeat.delay") == 1

@@ -12,7 +12,10 @@ import time
 import numpy as np
 import pytest
 
+import launchutil
 from mxnet_tpu.parallel import ps_async
+
+pytestmark = pytest.mark.launched
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -80,7 +83,7 @@ def test_async_slow_worker_does_not_block_fast(tmp_path):
     try:
         fast = _spawn_worker(tmp_path, 0, 20, 0.0, port)
         slow = _spawn_worker(tmp_path, 1, 3, 1.5, port)
-        out_fast, _ = fast.communicate(timeout=120)
+        out_fast, _ = launchutil.communicate(fast, timeout=120)
         assert fast.returncode == 0, out_fast
         assert "DONE" in out_fast
         # the worker-reported push-loop time excludes the ~15s process
@@ -88,7 +91,7 @@ def test_async_slow_worker_does_not_block_fast(tmp_path):
         # >=4.5s of sleep — impossible if pushes barriered across workers
         fast_loop = float(out_fast.split("DONE")[1].split()[0])
         assert fast_loop < 4.0, (fast_loop, out_fast)
-        out_slow, _ = slow.communicate(timeout=120)
+        out_slow, _ = launchutil.communicate(slow, timeout=120)
         assert slow.returncode == 0, out_slow
         slow_loop = float(out_slow.split("DONE")[1].split()[0])
         assert slow_loop >= 4.5  # it really was sleeping through its loop
@@ -197,7 +200,7 @@ def test_module_fit_against_async_ps(tmp_path):
                 stderr=subprocess.STDOUT, text=True))
         accs = []
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = launchutil.communicate(p, timeout=240)
             assert p.returncode == 0, out
             accs.append(float(out.split("ACC")[1].split()[0]))
         assert all(a > 0.9 for a in accs), (accs,)
@@ -299,11 +302,11 @@ def test_async_four_workers_one_straggler(tmp_path):
                 for r in range(3)]
         slow = _spawn_worker(tmp_path, 3, 3, 1.5, port, extra)
         for p in fast:
-            out, _ = p.communicate(timeout=180)
+            out, _ = launchutil.communicate(p, timeout=180)
             assert p.returncode == 0, out
             loop = float(out.split("DONE")[1].split()[0])
             assert loop < 4.0, (loop, out)
-        out_slow, _ = slow.communicate(timeout=180)
+        out_slow, _ = launchutil.communicate(slow, timeout=180)
         assert slow.returncode == 0, out_slow
         assert float(out_slow.split("DONE")[1].split()[0]) >= 4.5
         c = ps_async.AsyncPSClient((host, port), rank=9)

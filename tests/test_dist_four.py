@@ -5,20 +5,20 @@ processes).
 """
 import os
 import re
-import socket
 import subprocess
 import sys
+
+import pytest
+
+import launchutil
+
+pytestmark = pytest.mark.launched
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = os.path.join(REPO, "tools")
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+_free_port = launchutil.free_port
 
 
 def test_compressed_dist_sync_four_workers(tmp_path):
@@ -134,8 +134,7 @@ def test_one_dead_of_four_detected(tmp_path):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for rank in range(4)]
     outs, errs = [], []
-    for rank, p in enumerate(procs):
-        out, err = p.communicate(timeout=180)
+    for out, err in launchutil.communicate_all(procs, timeout=180):
         outs.append(out)
         errs.append(err)
     assert procs[0].returncode == 0, (outs[0], errs[0][-2000:])

@@ -5,11 +5,14 @@ coordinator KV store; `kv.get_num_dead_node(timeout)` counts stale peers.
 Launched test: two jax.distributed CPU processes — one exits early
 (simulated death) and the survivor must observe exactly one dead node."""
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
+
+import launchutil
+
+pytestmark = pytest.mark.launched
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -54,12 +57,7 @@ os._exit(0)  # die without cleanup, like a crashed worker
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+_free_port = launchutil.free_port
 
 
 @pytest.mark.timeout(180)
@@ -75,7 +73,10 @@ def test_dead_worker_detected(tmp_path):
     victim = subprocess.Popen(
         [sys.executable, str(tmp_path / "victim.py"), coord],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-    out, _ = survivor.communicate(timeout=150)
-    victim.wait(timeout=30)
+    out, _ = launchutil.communicate(survivor, timeout=150)
+    try:
+        victim.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        victim.kill()
     assert survivor.returncode == 0, out
     assert "ALL ALIVE" in out and "DEAD NODES 1" in out, out

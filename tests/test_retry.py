@@ -1,0 +1,156 @@
+"""Bounded exponential backoff with jitter (`parallel/retry.py`) — the
+transient-failure layer under dist.init, coordinator KV ops, and
+KVStore.barrier: max-attempts honored, geometric growth capped at
+max_delay, jitter inside its declared bounds."""
+import pytest
+
+from mxnet_tpu.parallel import retry
+
+
+@pytest.fixture
+def no_sleep(monkeypatch):
+    """Capture backoff delays instead of sleeping."""
+    sleeps = []
+    monkeypatch.setattr(retry, "_sleep", sleeps.append)
+    return sleeps
+
+
+def test_success_first_try(no_sleep):
+    p = retry.RetryPolicy(max_attempts=5)
+    assert retry.retry_call(lambda: 7, policy=p) == 7
+    assert p.last_attempts == 1
+    assert no_sleep == []
+
+
+def test_max_attempts_honored(no_sleep):
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("down")
+
+    p = retry.RetryPolicy(max_attempts=4, base_delay=0.1, jitter=0.0)
+    with pytest.raises(retry.RetryError) as ei:
+        retry.retry_call(boom, policy=p)
+    assert len(calls) == 4
+    assert p.last_attempts == 4
+    assert ei.value.attempts == 4
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert len(no_sleep) == 3  # no sleep after the final failure
+
+
+def test_recovers_midway(no_sleep):
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = retry.RetryPolicy(max_attempts=5, base_delay=0.1, jitter=0.0)
+    assert retry.retry_call(flaky, policy=p) == "ok"
+    assert p.last_attempts == 3
+
+
+def test_backoff_growth_and_cap(no_sleep):
+    def boom():
+        raise ValueError("x")
+
+    p = retry.RetryPolicy(max_attempts=6, base_delay=0.1, multiplier=2.0,
+                          max_delay=0.5, jitter=0.0)
+    with pytest.raises(retry.RetryError):
+        retry.retry_call(boom, policy=p)
+    # geometric 0.1, 0.2, 0.4 then capped at max_delay
+    assert no_sleep == pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5])
+
+
+def test_jitter_bounds():
+    p = retry.RetryPolicy(base_delay=1.0, multiplier=2.0, max_delay=64.0,
+                          jitter=0.5, seed=123)
+    for attempt in range(1, 6):
+        base = min(64.0, 1.0 * 2.0 ** (attempt - 1))
+        samples = [p.delay_for(attempt) for _ in range(200)]
+        assert all(base * 0.5 <= s <= base for s in samples)
+        # jitter actually spreads the delays (not a constant)
+        assert max(samples) - min(samples) > base * 0.3
+
+
+def test_jitter_deterministic_with_seed():
+    a = retry.RetryPolicy(jitter=0.5, seed=7)
+    b = retry.RetryPolicy(jitter=0.5, seed=7)
+    assert [a.delay_for(k) for k in range(1, 5)] == \
+        [b.delay_for(k) for k in range(1, 5)]
+
+
+def test_non_retryable_exception_propagates(no_sleep):
+    def bad():
+        raise KeyError("logic bug")
+
+    p = retry.RetryPolicy(max_attempts=5, retry_on=(OSError,))
+    with pytest.raises(KeyError):
+        retry.retry_call(bad, policy=p)
+    assert no_sleep == []  # never retried
+
+
+def test_on_retry_hook_sees_each_failure(no_sleep):
+    seen = []
+
+    def boom():
+        raise RuntimeError("x")
+
+    p = retry.RetryPolicy(max_attempts=3, base_delay=0.1, jitter=0.0)
+    with pytest.raises(retry.RetryError):
+        retry.retry_call(boom, policy=p,
+                         on_retry=lambda a, e, d: seen.append((a, d)))
+    assert [a for a, _ in seen] == [1, 2]
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_T_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("MXNET_T_BASE_DELAY", "0.25")
+    p = retry.RetryPolicy.from_env("MXNET_T", max_attempts=3,
+                                   base_delay=1.0, max_delay=9.0)
+    assert p.max_attempts == 7
+    assert p.base_delay == 0.25
+    assert p.max_delay == 9.0  # default kept where env is unset
+
+
+def test_timeout_like_predicate(no_sleep):
+    class XlaRuntimeError(Exception):  # stand-in for jaxlib's
+        pass
+
+    assert retry.timeout_like(TimeoutError("t"))
+    assert retry.timeout_like(XlaRuntimeError("DEADLINE_EXCEEDED: barrier"))
+    assert retry.timeout_like(XlaRuntimeError("UNAVAILABLE: conn reset"))
+    assert not retry.timeout_like(XlaRuntimeError("INVALID_ARGUMENT"))
+    assert not retry.timeout_like(RuntimeError("DEADLINE_EXCEEDED"))
+
+    # as a retry_on predicate: coordinator-style RPC timeouts retry,
+    # anything else propagates on the first attempt
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise XlaRuntimeError("DEADLINE_EXCEEDED: deadline exceeded")
+        return "ok"
+
+    p = retry.RetryPolicy(max_attempts=3, base_delay=0.1, jitter=0.0)
+    assert retry.retry_call(flaky, policy=p,
+                            retry_on=retry.timeout_like) == "ok"
+    assert p.last_attempts == 2
+
+    def hard():
+        raise XlaRuntimeError("INVALID_ARGUMENT: bad mesh")
+
+    with pytest.raises(XlaRuntimeError):
+        retry.retry_call(hard, policy=p, retry_on=retry.timeout_like)
+    assert p.last_attempts == 1  # not retried
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        retry.RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        retry.RetryPolicy(jitter=1.5)
