@@ -126,6 +126,21 @@ def main():
     }), flush=True)
     if "--infer-only" not in sys.argv:
         bench_train()
+    write_telemetry_snapshot()
+
+
+def write_telemetry_snapshot():
+    """Drop the run's telemetry registry (Prometheus text) next to the
+    JSON metric lines, so a bench round leaves machine-readable runtime
+    series (kvstore traffic, dispatch timings, fit phases) behind, not
+    just the headline numbers."""
+    from mxnet_tpu import telemetry
+    path = telemetry.write_snapshot(
+        None if telemetry.configured_dir()
+        else os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_telemetry.prom"))
+    print(json.dumps({"metric": "telemetry_snapshot", "value": path,
+                      "unit": "path"}), flush=True)
 
 
 if __name__ == "__main__":

@@ -59,7 +59,16 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Throughput logger (reference callback.py Speedometer)."""
+    """Throughput logger (reference callback.py Speedometer).
+
+    Speed comes from the telemetry registry (`fit_samples_total`, written
+    per batch by `Module.fit`) so the printed number and the exported
+    metrics can never disagree; outside an instrumented fit loop (the
+    counter not advancing) it falls back to the reference's
+    ``frequent * batch_size / elapsed`` arithmetic. The counter is
+    process-global: with several fit loops running concurrently in one
+    process each Speedometer reports the PROCESS throughput over its
+    window, not its own loop's share."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -68,6 +77,23 @@ class Speedometer:
         self.tic = 0
         self.last_count = 0
         self.auto_reset = auto_reset
+        self._samples_tic = 0.0
+
+    @staticmethod
+    def _registry_samples():
+        from . import telemetry
+        return telemetry.counter("fit_samples_total").value
+
+    def _mark(self):
+        self.tic = time.time()
+        self._samples_tic = self._registry_samples()
+
+    def _speed(self):
+        elapsed = time.time() - self.tic
+        done = self._registry_samples() - self._samples_tic
+        if done > 0:
+            return done / elapsed
+        return self.frequent * self.batch_size / elapsed
 
     def __call__(self, param):
         count = param.nbatch
@@ -77,7 +103,7 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                speed = self._speed()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -89,10 +115,10 @@ class Speedometer:
                 else:
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                                  param.epoch, count, speed)
-                self.tic = time.time()
+                self._mark()
         else:
             self.init = True
-            self.tic = time.time()
+            self._mark()
 
 
 class ProgressBar:

@@ -33,6 +33,8 @@ import re
 import threading
 from contextlib import contextmanager
 
+from . import telemetry
+
 __all__ = ["arm", "armed", "arm_from_env", "clear", "fire", "fired",
            "is_armed", "ChaosError", "ChaosTimeout", "ChaosInterrupt",
            "maybe_timeout", "maybe_die", "maybe_interrupt_checkpoint",
@@ -147,18 +149,32 @@ def fired(site):
         return _fired.get(site, 0)
 
 
+_NO_FIRE = object()
+
+
 def fire(site):
     """Poll an injection point. Returns ``None`` when nothing injects;
-    otherwise the armed value (``True`` when no value was given)."""
+    otherwise the armed value (``True`` when no value was given). Every
+    injection is counted in the telemetry registry
+    (``chaos_injections_total{site=...}``) so tests assert exact counts
+    instead of scraping logs."""
     _check_site(site)
+    result = _NO_FIRE
     with _lock:
         for trig in _triggers.get(site, ()):
             if trig.poll():
                 _fired[site] = _fired.get(site, 0) + 1
                 logging.warning("chaos: firing %s (hit %d/%d, value=%r)",
                                 site, trig.hits, trig.times, trig.value)
-                return True if trig.value is None else trig.value
-    return None
+                result = True if trig.value is None else trig.value
+                break
+    if result is _NO_FIRE:
+        return None
+    telemetry.counter("chaos_injections_total",
+                      help="fault injections delivered, by site",
+                      site=site).inc()
+    telemetry.event("chaos.injection", site=site, value=result)
+    return result
 
 
 @contextmanager
@@ -187,6 +203,7 @@ def maybe_die():
     if val is not None:
         code = DEAD_EXIT_CODE if val is True else int(val)
         logging.warning("chaos: worker death, os._exit(%d)", code)
+        telemetry.flush()  # os._exit skips atexit; keep the logs durable
         os._exit(code)
 
 

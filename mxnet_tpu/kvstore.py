@@ -28,13 +28,47 @@ reference running optimizer kernels inside the engine.
 from __future__ import annotations
 
 import pickle
+import time
 
+from . import telemetry
 from .base import MXNetError
 from .context import cpu, current_context
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
 __all__ = ["KVStore", "create"]
+
+
+def _nd_nbytes(v):
+    """Best-effort payload size of an NDArray-ish value (dense ._data,
+    compact row-sparse aux arrays, or a bare jnp/np array)."""
+    data = getattr(v, "_data", None)
+    n = getattr(data, "nbytes", None)
+    if n is not None:
+        return int(n)
+    aux = getattr(v, "_aux", None)
+    if isinstance(aux, dict):
+        return sum(int(getattr(a, "nbytes", 0) or 0) for a in aux.values())
+    return int(getattr(v, "nbytes", 0) or 0)
+
+
+def _record_kv(op, t0, values, store_type):
+    """Fold one push/pull into the telemetry registry: call count, bytes
+    moved, and latency (reference analog: ps-lite's ZPush/ZPull had no
+    such accounting at all)."""
+    nbytes = sum(_nd_nbytes(v) for v in values)
+    telemetry.counter("kvstore_%s_total" % op,
+                      help="kvstore %s calls" % op).inc()
+    telemetry.counter("kvstore_%s_bytes_total" % op,
+                      help="payload bytes through kvstore %s" % op
+                      ).inc(nbytes)
+    dur = time.perf_counter() - t0
+    telemetry.histogram("kvstore_%s_seconds" % op,
+                        help="kvstore %s latency" % op).observe(dur)
+    if telemetry.configured_dir() is not None:
+        telemetry.event("kvstore.%s" % op, bytes=nbytes,
+                        dur=round(dur, 6), type=store_type)
+    return nbytes
 
 
 def _ctx_group_sum(vals):
@@ -114,6 +148,7 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         from .ndarray import sparse as _sp
+        t0 = time.perf_counter()
         keys, values = _normalize(key, value)
         merged_list = []
         for k, vs in zip(keys, values):
@@ -153,6 +188,7 @@ class KVStore:
                 stored[:] = merged.as_in_context(stored.context)
         if batch:
             self._apply_updates(batch)
+        _record_kv("push", t0, merged_list, self.type)
 
     def _apply_updates(self, batch):
         """Run the updater over pushed keys; a list push with the standard
@@ -177,7 +213,9 @@ class KVStore:
             self._updater(k, merged, stored)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        t0 = time.perf_counter()
         keys, outs = _normalize(key, out)
+        pulled = []
         for k, os in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -185,6 +223,8 @@ class KVStore:
             src = self._store[k]
             for o in os:
                 src.copyto(o)
+                pulled.append(src)
+        _record_kv("pull", t0, pulled, self.type)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference kvstore.h:195-207).
@@ -400,20 +440,28 @@ class AsyncKVStore(KVStore):
             self._store[k] = vlist[0].copy()
 
     def push(self, key, value, priority=0):
+        t0 = time.perf_counter()
         keys, values = _normalize(key, value)
+        merged_list = []
         for k, vs in zip(keys, values):
             vs = vs if isinstance(vs, list) else [vs]
             merged = _ctx_group_sum(vs)
             # ship and return: the server updates on receipt; no barrier
             self._client.push(k, merged.asnumpy())
+            merged_list.append(merged)
+        _record_kv("push", t0, merged_list, self.type)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        t0 = time.perf_counter()
         keys, outs = _normalize(key, out)
+        pulled = []
         for k, os_ in zip(keys, outs):
             os_ = os_ if isinstance(os_, list) else [os_]
             val = self._client.pull(k)
             for o in os_:
                 o[:] = val
+                pulled.append(o)
+        _record_kv("pull", t0, pulled, self.type)
 
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer

@@ -47,19 +47,37 @@ def getLogger(name=None, filename=None, filemode=None, level=WARNING):
 
 
 def get_logger(name=None, filename=None, filemode=None, level=WARNING):
-    """Logger with the framework's colored formatter (reference log.py:90)."""
+    """Logger with the framework's colored formatter (reference log.py:90).
+
+    The root logger (``name=None``) gets the formatter like any named
+    logger, and calling again with a DIFFERENT ``filename`` (or switching
+    between stream and file) replaces the previously installed handler
+    instead of stacking a second one — the old destination stops
+    receiving records. Repeated calls with the same destination are
+    no-ops beyond returning the logger."""
     logger = logging.getLogger(name)
-    if name is not None and not getattr(logger, "_init_done", None):
-        logger._init_done = True
-        if filename:
-            mode = filemode if filemode else "a"
-            hdlr = logging.FileHandler(filename, mode)
-            hdlr.setFormatter(_Formatter(colored=False))
-        else:
-            hdlr = logging.StreamHandler()
-            hdlr.setFormatter(_Formatter(
-                colored=hasattr(sys.stderr, "isatty")
-                and sys.stderr.isatty()))
-        logger.addHandler(hdlr)
-        logger.setLevel(level)
+    dest = (filename, filemode or "a") if filename else None
+    if getattr(logger, "_mx_log_dest", ()) == dest:
+        return logger
+    old = getattr(logger, "_mx_log_handler", None)
+    if old is not None:
+        logger.removeHandler(old)
+        old.close()
+    if filename:
+        hdlr = logging.FileHandler(filename, filemode or "a")
+        hdlr.setFormatter(_Formatter(colored=False))
+    else:
+        hdlr = logging.StreamHandler()
+        hdlr.setFormatter(_Formatter(
+            colored=hasattr(sys.stderr, "isatty")
+            and sys.stderr.isatty()))
+    logger.addHandler(hdlr)
+    if name is not None:
+        # a named logger with its own handler must not ALSO propagate to
+        # root: once root carries the framework handler too, every
+        # record would print twice
+        logger.propagate = False
+    logger._mx_log_handler = hdlr
+    logger._mx_log_dest = dest
+    logger.setLevel(level)
     return logger

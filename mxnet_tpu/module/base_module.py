@@ -15,6 +15,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import io as io_mod
+from .. import telemetry
 from ..ndarray import NDArray
 
 
@@ -24,6 +25,21 @@ class BatchEndParam:
         self.nbatch = nbatch
         self.eval_metric = eval_metric
         self.locals = locals
+
+
+def _count_fit_batch(batch):
+    """Per-batch throughput series: `callback.Speedometer` reads its
+    samples/sec from these counters instead of recomputing locally."""
+    try:
+        samples = int(batch.data[0].shape[0])
+    except Exception:
+        samples = 0
+    telemetry.counter("fit_batches_total",
+                      help="train batches completed by Module.fit").inc()
+    if samples:
+        telemetry.counter("fit_samples_total",
+                          help="train samples completed by Module.fit"
+                          ).inc(samples)
 
 
 def _as_list(obj):
@@ -232,37 +248,47 @@ class BaseModule:
                 if use_scan:
                     # gather up to K batches, run them in one dispatch
                     group = [next_data_batch]
-                    while len(group) < batches_per_dispatch:
-                        try:
-                            nb = next(data_iter)
-                            self.prepare(nb, sparse_row_id_fn=sparse_row_id_fn)
-                        except StopIteration:
-                            end_of_batch = True
-                            break
-                        if nb.data[0].shape != group[0].data[0].shape:
-                            next_data_batch = nb  # bucketing boundary
-                            break
-                        group.append(nb)
+                    with telemetry.span("fit.data", phase="scan_gather"):
+                        while len(group) < batches_per_dispatch:
+                            try:
+                                nb = next(data_iter)
+                                self.prepare(nb,
+                                             sparse_row_id_fn=sparse_row_id_fn)
+                            except StopIteration:
+                                end_of_batch = True
+                                break
+                            if nb.data[0].shape != group[0].data[0].shape:
+                                next_data_batch = nb  # bucketing boundary
+                                break
+                            group.append(nb)
+                        else:
+                            try:
+                                next_data_batch = next(data_iter)
+                                self.prepare(next_data_batch,
+                                             sparse_row_id_fn=sparse_row_id_fn)
+                            except StopIteration:
+                                end_of_batch = True
+                    if len(group) > 1:
+                        with telemetry.span("fit.compute",
+                                            batches=len(group)):
+                            stacked = self._step_scan(group)
                     else:
-                        try:
-                            next_data_batch = next(data_iter)
-                            self.prepare(next_data_batch,
-                                         sparse_row_id_fn=sparse_row_id_fn)
-                        except StopIteration:
-                            end_of_batch = True
-                    stacked = self._step_scan(group) if len(group) > 1 \
-                        else False
+                        stacked = False
                     for k_i, b in enumerate(group):
                         if stacked is False:  # unsupported: per-batch steps
-                            self._step(b)
-                        if stacked:
-                            outs = {name: out[k_i] for name, out in
-                                    zip(self.output_names, stacked)}
-                            eval_metric.update_dict(
-                                dict(zip(self._label_names, b.label or [])),
-                                outs)
-                        else:
-                            self.update_metric(eval_metric, b.label)
+                            with telemetry.span("fit.compute"):
+                                self._step(b)
+                        with telemetry.span("fit.sync"):
+                            if stacked:
+                                outs = {name: out[k_i] for name, out in
+                                        zip(self.output_names, stacked)}
+                                eval_metric.update_dict(
+                                    dict(zip(self._label_names,
+                                             b.label or [])),
+                                    outs)
+                            else:
+                                self.update_metric(eval_metric, b.label)
+                        _count_fit_batch(b)
                         if batch_end_callback is not None:
                             batch_end_params = BatchEndParam(
                                 epoch=epoch, nbatch=nbatch,
@@ -272,18 +298,26 @@ class BaseModule:
                         nbatch += 1
                     continue
                 data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
-                else:
-                    self._step(data_batch)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                with telemetry.span("fit.compute"):
+                    if monitor is not None:
+                        monitor.tic()
+                        self.forward_backward(data_batch)
+                        self.update()
+                    else:
+                        self._step(data_batch)
+                with telemetry.span("fit.data") as _dspan:
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
+                        _dspan["end_of_epoch"] = True
+                with telemetry.span("fit.sync"):
+                    # metric update reads outputs to host: this is where
+                    # the step's async device work is actually awaited
+                    self.update_metric(eval_metric, data_batch.label)
+                _count_fit_batch(data_batch)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

@@ -23,6 +23,8 @@ import os
 import numpy as np
 import jax
 
+from .. import telemetry
+
 __all__ = ["init", "shutdown", "allreduce_nd", "allreduce_nds",
            "broadcast_nd", "barrier", "rank", "size", "start_heartbeat",
            "stop_heartbeat", "num_dead_nodes"]
@@ -58,6 +60,11 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
     if recoverable is None:
         recoverable = os.environ.get("MXNET_RECOVERABLE", "0") == "1"
     if coordinator_address:
+        if process_id is not None:
+            # stamp telemetry host id BEFORE the attach: the retry/chaos
+            # events fired while connecting must carry the real rank
+            # (jax's own process id is not known until the attach lands)
+            telemetry.set_host_id(process_id)
         # coordinator attach is the classic transient: workers race the
         # coordinator process coming up, and a preempted coordinator
         # returns timeouts for a while before recovering — retry with
@@ -82,11 +89,16 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
                 _clear_jax_distributed_state()
                 raise
 
-        _retry.retry_call(
-            _attach, policy=_retry.RetryPolicy.from_env(
-                "MXNET_INIT", max_attempts=4, base_delay=0.5, max_delay=10.0),
-            retry_on=_retry.timeout_like,  # config errors must fail fast
-            describe="jax.distributed.initialize")
+        with telemetry.span("dist.init", coordinator=coordinator_address,
+                            process_id=process_id):
+            _retry.retry_call(
+                _attach, policy=_retry.RetryPolicy.from_env(
+                    "MXNET_INIT", max_attempts=4, base_delay=0.5,
+                    max_delay=10.0),
+                retry_on=_retry.timeout_like,  # config errors fail fast
+                describe="jax.distributed.initialize")
+        telemetry.counter("dist_init_total",
+                          help="successful coordinator attaches").inc()
     _initialized = True
     # liveness protocol on by default for multi-process runs (reference
     # ps-lite heartbeats are always on, van.cc); cheap: one tiny KV write
@@ -356,14 +368,24 @@ def start_heartbeat(interval=5.0):
     from .. import chaos
 
     def beat():
+        last = None
         while True:
             extra = chaos.heartbeat_extra_delay()
             if extra:  # injected network stall: the beat arrives late
                 _time.sleep(extra)
+            now = _time.time()
+            if last is not None:
+                # liveness-gap series: in a healthy run this sits at
+                # ~interval; chaos stalls and coordinator hiccups show
+                # up as p99 outliers long before a peer is declared dead
+                telemetry.histogram(
+                    "heartbeat_gap_seconds",
+                    help="gap between successive liveness writes"
+                ).observe(now - last)
+            last = now
             try:
                 client.key_value_set("%s/%d" % (_HB_PREFIX, me),
-                                     repr(_time.time()),
-                                     allow_overwrite=True)
+                                     repr(now), allow_overwrite=True)
             except Exception:  # pragma: no cover - coordinator gone
                 return
             if stop_evt.wait(interval):
@@ -411,6 +433,14 @@ def _num_dead_nodes_nochaos(timeout):
     """num_dead_nodes without the chaos poll — for background monitors
     (the elastic watchdog) whose own polling would otherwise race the
     main thread for armed triggers and break chaos determinism."""
+    dead = _count_stale_peers(timeout)
+    telemetry.gauge("dist_dead_nodes",
+                    help="peers with stale/missing heartbeats at the "
+                         "last liveness poll").set(dead)
+    return dead
+
+
+def _count_stale_peers(timeout):
     client = _coordinator_client()
     if client is None or jax.process_count() == 1:
         return 0

@@ -39,6 +39,7 @@ from .checkpoint import (COMMIT_FILE, abstract_like, load_sharded,
 from .retry import RetryError, RetryPolicy, retry_call
 from . import retry as _retry_mod
 from .. import chaos
+from .. import telemetry
 
 __all__ = ["ElasticCheckpointer", "ElasticTrainer", "run_elastic",
            "supervise", "WorkerFailure", "RESTART_EXIT_CODE",
@@ -162,6 +163,10 @@ class ElasticCheckpointer:
         same marker.
         """
         step = int(step)
+        with telemetry.span("elastic.checkpoint.save", step=step):
+            return self._save_impl(step, tree, aux)
+
+    def _save_impl(self, step, tree, aux):
         if self._resolved_backend() == "local":
             target = self._local_path(step)
             if _process_index() == 0:
@@ -205,6 +210,10 @@ class ElasticCheckpointer:
     def restore(self, template, step=None):
         """Load checkpoint ``step`` (default: latest complete) onto the
         placements in ``template``. Returns ``(step, tree)``."""
+        with telemetry.span("elastic.checkpoint.restore", step=step):
+            return self._restore_impl(template, step)
+
+    def _restore_impl(self, template, step):
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -351,6 +360,11 @@ class ElasticTrainer:
                     logging.error(
                         "elastic watchdog: %d dead node(s); exiting %d "
                         "for supervisor restart", dead, RESTART_EXIT_CODE)
+                    telemetry.counter(
+                        "elastic_watchdog_exits_total",
+                        help="watchdog-initiated restart exits").inc()
+                    telemetry.event("elastic.watchdog_exit", dead=dead)
+                    telemetry.flush()  # os._exit skips atexit
                     os._exit(RESTART_EXIT_CODE)
 
         threading.Thread(target=watch, daemon=True,
@@ -387,6 +401,10 @@ class ElasticTrainer:
     # -- recovery ---------------------------------------------------------
     def _recover(self, state, exc):
         self.restarts_used += 1
+        telemetry.counter("elastic_recoveries_total",
+                          help="in-process recover cycles entered").inc()
+        telemetry.event("elastic.recover", restart=self.restarts_used,
+                        error=str(exc)[:200])
         if self.restarts_used > self.max_restarts:
             raise RetryError(
                 "elastic: giving up after %d restarts (last failure: %s)"
@@ -441,6 +459,9 @@ class ElasticTrainer:
                                       "step %d: %s; exiting %d for "
                                       "supervisor restart", step, exc,
                                       RESTART_EXIT_CODE)
+                        telemetry.event("elastic.step_exit", step=step,
+                                        error=str(exc)[:200])
+                        telemetry.flush()  # os._exit skips atexit
                         os._exit(RESTART_EXIT_CODE)
                     step, state = self._recover(state, exc)
                     continue
@@ -610,6 +631,11 @@ def supervise(worker_argv, nprocs, max_restarts=3, env=None, log_dir=None,
         if failed is None:
             return restart, log_dir
         last_fail = failed
+        telemetry.counter("elastic_pod_relaunches_total",
+                          help="supervisor rounds that failed and were "
+                               "(or would be) relaunched").inc()
+        telemetry.event("elastic.pod_relaunch", round=restart,
+                        reason=failed)
         logging.warning("elastic supervise: %s; %s", failed,
                         "relaunching pod" if restart < max_restarts
                         else "out of restarts")

@@ -19,6 +19,8 @@ import logging
 import random
 import time
 
+from .. import telemetry
+
 __all__ = ["RetryPolicy", "RetryError", "retry_call", "timeout_like"]
 
 _sleep = time.sleep  # monkeypatch point for tests
@@ -112,10 +114,19 @@ def retry_call(fn, *args, policy=None, retry_on=None, describe=None,
             if not retryable:
                 raise
             if attempt >= policy.max_attempts:
+                telemetry.counter("retry_exhausted_total",
+                                  help="calls that ran out of attempts",
+                                  call=describe).inc()
                 raise RetryError(
                     "%s failed after %d attempts: %s"
                     % (describe, attempt, exc), attempt) from exc
             delay = policy.delay_for(attempt)
+            telemetry.counter("retry_attempts_total",
+                              help="transient failures retried with "
+                                   "backoff, by call site",
+                              call=describe).inc()
+            telemetry.event("retry", call=describe, attempt=attempt,
+                            delay=round(delay, 4), error=str(exc)[:200])
             logging.warning("%s failed (attempt %d/%d): %s — retrying in "
                             "%.2fs", describe, attempt, policy.max_attempts,
                             exc, delay)

@@ -27,10 +27,13 @@ import time
 
 import jax
 
+from . import telemetry
+
 __all__ = ["set_config", "set_state", "dump", "dumps", "device_dumps",
            "pause", "resume", "reset_stats"]
 
-_state = {"running": False, "dir": "profile_output", "configured": False}
+_state = {"running": False, "dir": "profile_output", "configured": False,
+          "paused": False}
 _agg = {
     "enabled": False,
     "memory": False,
@@ -73,12 +76,26 @@ def pause(profile_process="worker"):
     if _state["running"]:
         jax.profiler.stop_trace()
         _state["running"] = False
+        _state["paused"] = True
 
 
 def resume(profile_process="worker"):
-    if not _state["running"]:
-        jax.profiler.start_trace(_state["dir"])
-        _state["running"] = True
+    """Resume a paused trace. A bare ``resume()`` with no prior
+    ``set_config``/``pause`` used to silently start a trace into the
+    default directory — now it warns and does nothing: resume is the
+    second half of a pause/resume pair, not a start button."""
+    if _state["running"]:
+        return
+    if not (_state["configured"] or _state["paused"]):
+        import warnings
+        warnings.warn(
+            "profiler.resume() called before set_config()/pause(): no "
+            "trace is configured, nothing to resume — call set_config() "
+            "and set_state('run') to start one", stacklevel=2)
+        return
+    jax.profiler.start_trace(_state["dir"])
+    _state["running"] = True
+    _state["paused"] = False
 
 
 # ---------------------------------------------------------------------------
@@ -95,7 +112,12 @@ def memory_enabled():
 
 def record_op(name, dur_s, out_bytes=0):
     """Fold one timed dispatch into the aggregate table. Called by the
-    eager dispatcher (`ops/invoke.py`) and the executor's compiled calls."""
+    eager dispatcher (`ops/invoke.py`) and the executor's compiled calls.
+    Also feeds the run-level telemetry registry, so op dispatch shows up
+    next to kvstore/checkpoint/retry series in `telemetry.dumps()`."""
+    telemetry.histogram("op_dispatch_seconds",
+                        help="timed dispatches (aggregate mode), by op",
+                        op=name).observe(dur_s)
     us = dur_s * 1e6
     rec = _agg["ops"].get(name)
     if rec is None:
@@ -213,5 +235,9 @@ def finish_timed(name, t0, outs):
 
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
-    set_config()
+    # MXNET_PROFILER_AGGREGATE=1 makes the autostarted run ALSO collect
+    # the aggregate table (reference env_var.md: autostart alone only
+    # captures the trace); dumps() then has data without code changes
+    set_config(aggregate_stats=os.environ.get(
+        "MXNET_PROFILER_AGGREGATE", "0") == "1")
     set_state("run")

@@ -88,3 +88,34 @@ def test_heartbeat_delay_injection_in_dist_writer():
     chaos.arm("heartbeat.delay", value=0.0)
     assert chaos.heartbeat_extra_delay() == 0.0
     assert chaos.fired("heartbeat.delay") == 1
+
+
+def test_injections_counted_in_telemetry_registry():
+    """Satellite (PR 2): every injection lands in
+    `chaos_injections_total{site=...}` so tests assert EXACT counts from
+    the metrics registry instead of scraping warning logs."""
+    from mxnet_tpu import telemetry
+
+    def count(site):
+        m = telemetry.get_metric("chaos_injections_total", site=site)
+        return m.value if m is not None else 0.0
+
+    base_fail = count("step.fail")
+    base_to = count("coordinator.timeout")
+    chaos.arm("step.fail", after=1, times=3)
+    fired = 0
+    for _ in range(6):
+        fired += chaos.fire("step.fail") is not None
+    assert fired == 3
+    # exact equality: registry delta == injections delivered == fired()
+    assert count("step.fail") - base_fail == 3
+    assert count("step.fail") - base_fail == chaos.fired("step.fail")
+    # polls that did NOT inject must not count
+    assert chaos.fire("step.fail") is None
+    assert count("step.fail") - base_fail == 3
+    # sites are independent series
+    chaos.arm("coordinator.timeout")
+    with pytest.raises(chaos.ChaosTimeout):
+        chaos.maybe_timeout()
+    assert count("coordinator.timeout") - base_to == 1
+    assert count("step.fail") - base_fail == 3

@@ -240,3 +240,95 @@ def test_legacy_misc_scheduler():
     import pytest
     with pytest.raises(ValueError):
         mx.misc.FactorScheduler(step=0)
+
+
+def test_get_logger_root_gets_formatter_and_replaces_handlers(tmp_path):
+    """Satellite (PR 2): the root logger (name=None) gets the colored
+    formatter like any named logger, and re-calling with a different
+    filename REPLACES the old handler instead of stacking a second."""
+    import logging
+    from mxnet_tpu.log import _Formatter
+
+    root = logging.getLogger()
+    saved = list(root.handlers)
+    try:
+        root.handlers = []
+        lg = mx.log.get_logger(level=mx.log.INFO)
+        ours = [h for h in lg.handlers
+                if isinstance(h.formatter, _Formatter)]
+        assert len(ours) == 1  # root got the framework formatter
+        assert mx.log.get_logger(level=mx.log.INFO) is lg
+        assert len([h for h in lg.handlers
+                    if isinstance(h.formatter, _Formatter)]) == 1
+    finally:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+            h.close()
+        root.handlers = saved
+        root._mx_log_dest = ()
+
+    f1, f2 = str(tmp_path / "a.log"), str(tmp_path / "b.log")
+    lg = mx.log.get_logger("telemetry_fix_test", filename=f1,
+                           level=mx.log.INFO)
+    lg.info("to-a")
+    # same destination: no new handler stacked
+    mx.log.get_logger("telemetry_fix_test", filename=f1, level=mx.log.INFO)
+    assert len(lg.handlers) == 1
+    # NEW destination: handler replaced, old file stops receiving
+    mx.log.get_logger("telemetry_fix_test", filename=f2, level=mx.log.INFO)
+    assert len(lg.handlers) == 1
+    lg.info("to-b")
+    a, b = open(f1).read(), open(f2).read()
+    assert "to-a" in a and "to-b" not in a
+    assert "to-b" in b
+
+
+def test_profiler_resume_without_config_is_a_noop(monkeypatch):
+    """Satellite (PR 2): a bare resume() used to silently start a trace
+    into the default directory; now it warns and starts nothing."""
+    import warnings
+    from mxnet_tpu import profiler
+
+    started = []
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: started.append(d))
+    monkeypatch.setitem(profiler._state, "configured", False)
+    monkeypatch.setitem(profiler._state, "paused", False)
+    monkeypatch.setitem(profiler._state, "running", False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        profiler.resume()
+    assert started == []
+    assert any("set_config" in str(x.message) for x in w)
+    assert not profiler._state["running"]
+    # after set_config, resume() is a legitimate start again
+    profiler.set_config(filename=str("/tmp/_prof_fix_test"))
+    profiler.resume()
+    assert started and profiler._state["running"]
+    monkeypatch.setitem(profiler._state, "running", False)
+    monkeypatch.setitem(profiler._state, "configured", False)
+
+
+def test_profiler_autostart_honors_aggregate_env(tmp_path):
+    """Satellite (PR 2): MXNET_PROFILER_AUTOSTART=1 +
+    MXNET_PROFILER_AGGREGATE=1 collects the aggregate table."""
+    import os
+    import subprocess
+    import sys
+    code = (
+        "import mxnet_tpu as mx\n"
+        "a = mx.nd.ones((8, 8))\n"
+        "(a + a).asnumpy()\n"
+        "mx.profiler.set_state('stop')\n"
+        "t = mx.profiler.dumps()\n"
+        "assert 'Profile Statistics.' in t, repr(t[:80])\n"
+        "print('AGG_OK')\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(mx.__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               MXNET_PROFILER_AUTOSTART="1", MXNET_PROFILER_AGGREGATE="1",
+               PYTHONPATH=repo)
+    r = subprocess.run([sys.executable, "-c", code], cwd=str(tmp_path),
+                       capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "AGG_OK" in r.stdout

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Stitch per-host telemetry JSONL event logs into one chrome-trace file.
+
+Every process of a multi-host run writes its own
+``events_host<h>_pid<p>.jsonl`` under ``MXNET_TELEMETRY_DIR``; this CLI
+(`mxnet_tpu.telemetry.merge`) aligns them on wall-clock into ONE timeline
+viewable in perfetto.dev or chrome://tracing, one trace-process row per
+host/pid::
+
+    python tools/merge_traces.py /tmp/run_telemetry -o run_trace.json
+    python tools/merge_traces.py hostA.jsonl hostB.jsonl -o trace.json
+
+Stdlib-only (imports just the telemetry module, which itself has no jax
+dependency), so it runs on a machine with nothing but the repo checkout.
+"""
+import argparse
+import importlib.util
+import os
+
+
+def _load_telemetry():
+    """Load mxnet_tpu/telemetry.py as a standalone module: importing the
+    mxnet_tpu PACKAGE would pull in jax, which this CLI must not need."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "mxnet_tpu", "telemetry.py")
+    spec = importlib.util.spec_from_file_location("_mxt_telemetry", path)
+    mod = importlib.util.module_from_spec(spec)
+    saved = os.environ.pop("MXNET_TELEMETRY_DIR", None)
+    try:
+        # the merger must only READ the dir, not arm its own event log
+        spec.loader.exec_module(mod)
+    finally:
+        if saved is not None:
+            os.environ["MXNET_TELEMETRY_DIR"] = saved
+    return mod
+
+
+telemetry = _load_telemetry()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("src", nargs="+",
+                    help="telemetry dir(s) or .jsonl event file(s)")
+    ap.add_argument("-o", "--out", default="merged_trace.json",
+                    help="chrome-trace JSON output path")
+    args = ap.parse_args(argv)
+    paths = []
+    for src in args.src:
+        if not os.path.exists(src):
+            ap.error("no such file or directory: %s" % src)
+        paths.extend(telemetry._event_files(src))
+    if not paths:
+        ap.error("no .jsonl event files under %s" % (args.src,))
+    trace = telemetry.merge(paths, out=args.out)
+    n_procs = sum(1 for e in trace["traceEvents"]
+                  if e.get("ph") == "M" and e.get("name") == "process_name")
+    print("merged %d events from %d file(s) / %d process(es) -> %s"
+          % (len(trace["traceEvents"]), len(paths), n_procs, args.out))
+
+
+if __name__ == "__main__":
+    main()
