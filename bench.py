@@ -32,6 +32,14 @@ BASELINE_IMG_S = 109.0  # K80 ResNet-50 batch-32 inference (BASELINE.md)
 TRAIN_TARGET_IMG_S = 2900.0  # A100-class train target (BASELINE.md)
 
 
+RECORDS = []  # every JSON metric line this run printed (for --gate)
+
+
+def emit(rec):
+    RECORDS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def bench_train():
     """ResNet-50 bf16 bs128 NHWC train img/s via Module._step_scan.
 
@@ -48,7 +56,7 @@ def bench_train():
     except Exception as e:
         sys.stderr.write("train benchmark failed: %r\n" % (e,))
         return
-    print(json.dumps(rec), flush=True)
+    emit(rec)
 
 
 def main():
@@ -107,26 +115,60 @@ def main():
     # must run them back-to-back) and reads once: bias ~= 90ms over the
     # whole round, ~2-3% at the rates measured here.
     calls = 8
-    float(loop(params, xv, jnp.float32(0)))  # compile
+    # AOT-compile the timed loop: one compile (same executable the timed
+    # calls run) and its cost_analysis gives the MFU/goodput numerator
+    from mxnet_tpu import xla_stats
+    compiled, info = xla_stats.aot_compile(loop, params, xv,
+                                           jnp.float32(0))
+    run = compiled if compiled is not None else loop
+    float(run(params, xv, jnp.float32(0)))  # compile / warm
     best = 0.0
+    best_dt = None
     for _ in range(2):
         t0 = time.time()
         acc = jnp.float32(0)
         for _ in range(calls):
-            acc = loop(params, xv, acc)
+            acc = run(params, xv, acc)
         float(acc)
         dt = time.time() - t0
-        best = max(best, batch * iters * calls / dt)
+        if batch * iters * calls / dt > best:
+            best = batch * iters * calls / dt
+            best_dt = dt
 
-    print(json.dumps({
+    emit({
         "metric": "resnet50_infer_imgs_per_sec_bs32",
         "value": round(best, 2),
         "unit": "img/s",
         "vs_baseline": round(best / BASELINE_IMG_S, 3),
-    }), flush=True)
+    })
+    write_goodput(info, calls, best_dt)
     if "--infer-only" not in sys.argv:
         bench_train()
     write_telemetry_snapshot()
+    if "--gate" in sys.argv:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_gate
+        raise SystemExit(bench_gate.gate_records(RECORDS))
+
+
+def write_goodput(info, calls, dt):
+    """`model_flops_per_second` and `mfu` metric lines for the measured
+    inference loop (flops from the compiled executable's cost_analysis;
+    peak table / MXNET_PEAK_FLOPS from `xla_stats`). Degrades to zeros
+    when the backend reports no cost analysis."""
+    import jax
+    from mxnet_tpu import xla_stats
+    flops = (info or {}).get("flops") or 0.0
+    mfps = flops * calls / dt if dt else 0.0
+    peak = xla_stats.peak_flops_total()
+    platform = jax.devices()[0].platform
+    g = xla_stats.publish_goodput(mfps)  # the one gauge publisher
+    emit({"metric": "model_flops_per_second", "value": round(mfps, 3),
+          "unit": "FLOP/s", "platform": platform})
+    emit({"metric": "mfu", "value": round(g["mfu"], 5),
+          "unit": "ratio", "platform": platform,
+          "peak_flops_total": peak})
 
 
 def write_telemetry_snapshot():

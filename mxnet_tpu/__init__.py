@@ -51,6 +51,7 @@ from . import executor_manager
 from . import gluon
 from . import image
 from . import profiler
+from . import xla_stats  # compile accounting / memory ledger / MFU / flight recorder
 from . import xplane
 from . import visualization
 from .visualization import print_summary

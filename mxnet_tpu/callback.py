@@ -84,9 +84,15 @@ class Speedometer:
         from . import telemetry
         return telemetry.counter("fit_samples_total").value
 
+    @staticmethod
+    def _registry_batches():
+        from . import telemetry
+        return telemetry.counter("fit_batches_total").value
+
     def _mark(self):
         self.tic = time.time()
         self._samples_tic = self._registry_samples()
+        self._batches_tic = self._registry_batches()
 
     def _speed(self):
         elapsed = time.time() - self.tic
@@ -94,6 +100,21 @@ class Speedometer:
         if done > 0:
             return done / elapsed
         return self.frequent * self.batch_size / elapsed
+
+    def _goodput_suffix(self):
+        """" mfu: X% (Y model FLOP/s)" for the window since the last
+        mark, or "" until a tracked train step has published its FLOPs
+        (`xla_stats.note_train_step`). Also refreshes the
+        `model_flops_per_second` / `mfu` gauges."""
+        from . import xla_stats
+        elapsed = time.time() - self.tic
+        batches = self._registry_batches() - \
+            getattr(self, "_batches_tic", 0.0)
+        g = xla_stats.goodput(batches, elapsed)
+        if not g:
+            return ""
+        return "\tmfu: %.2f%% (%.3e model FLOP/s)" % (
+            g["mfu"] * 100.0, g["model_flops_per_second"])
 
     def __call__(self, param):
         count = param.nbatch
@@ -104,17 +125,20 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self._speed()
+                goodput = self._goodput_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    msg += goodput.replace("%", "%%")
                     msg += "\t%s=%f" * len(name_value)
                     logging.info(msg, param.epoch, count, speed,
                                  *sum(name_value, ()))
                 else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
+                    logging.info(
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                        param.epoch, count, speed, goodput)
                 self._mark()
         else:
             self.init = True

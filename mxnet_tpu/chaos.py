@@ -203,6 +203,14 @@ def maybe_die():
     if val is not None:
         code = DEAD_EXIT_CODE if val is True else int(val)
         logging.warning("chaos: worker death, os._exit(%d)", code)
+        try:
+            # post-mortem ring of recent events; lazy import keeps chaos
+            # importable in stdlib-only contexts (merge tooling)
+            from . import xla_stats
+            xla_stats.dump_flight_recorder("chaos.worker.death",
+                                           error="os._exit(%d)" % code)
+        except Exception:
+            pass
         telemetry.flush()  # os._exit skips atexit; keep the logs durable
         os._exit(code)
 

@@ -229,7 +229,14 @@ class Executor:
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
         self._eval_fn = _build_eval(symbol, ctx)
-        self._jit_fwd = jax.jit(self._eval_fn, static_argnums=(3,))
+        # compile-accounted jits (xla_stats): cache hit/miss counters,
+        # compile spans, retrace explanations, per-executable FLOPs.
+        # Lineage = the Symbol: executors rebound over one graph
+        # (reshape/bucketing) diff as retraces; unrelated models don't.
+        from . import xla_stats
+        self._jit_fwd = xla_stats.tracked_jit(
+            self._eval_fn, "executor.forward", static_argnums=(3,),
+            lineage=id(symbol))
         if shardings:
             # replicated placement on the same mesh, for the RNG key: a jit
             # whose args span the mesh rejects a single-device key
@@ -240,7 +247,9 @@ class Executor:
             self._repl_sharding = None
         self._grad_names = [n for n in self._arg_names
                             if grad_req.get(n, "null") != "null"]
-        self._jit_fwd_bwd = jax.jit(self._fwd_bwd_impl)
+        self._jit_fwd_bwd = xla_stats.tracked_jit(
+            self._fwd_bwd_impl, "executor.forward_backward",
+            lineage=id(symbol))
         self._grouped = None
         self._group2ctx = group2ctx
         if group2ctx:
@@ -419,6 +428,10 @@ class Executor:
             grad_args, other_args, aux_vals, key, heads)
         if profiler.aggregate_enabled():
             profiler.finish_timed("_executor_forward_backward", t0, outs)
+        from . import xla_stats
+        if isinstance(self._jit_fwd_bwd, xla_stats.TrackedJit):
+            # the unfused train path: one fwd+bwd dispatch == one batch
+            xla_stats.note_train_step(self._jit_fwd_bwd, batches=1)
         for name, val in aux_up.items():
             self.aux_dict[name]._data = val
         for name, g in grads.items():

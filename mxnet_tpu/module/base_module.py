@@ -237,6 +237,32 @@ class BaseModule:
         if scan_unroll is not None:
             # unroll factor for the K-step scan (see Module._step_scan)
             self.scan_unroll = int(scan_unroll)
+        try:
+            self._fit_loop(train_data, eval_data, eval_metric,
+                           validation_metric, epoch_end_callback,
+                           batch_end_callback, eval_end_callback,
+                           eval_batch_end_callback, monitor,
+                           sparse_row_id_fn, batches_per_dispatch,
+                           use_scan, begin_epoch, num_epoch)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:
+            # crash flight recorder: leave the last N telemetry events +
+            # compile/step metadata on disk before the traceback
+            # unwinds, so post-mortems don't depend on scrollback
+            from .. import xla_stats
+            xla_stats.dump_flight_recorder(
+                "fit_exception",
+                error="%s: %s" % (type(exc).__name__, str(exc)[:400]))
+            raise
+
+    def _fit_loop(self, train_data, eval_data, eval_metric,
+                  validation_metric, epoch_end_callback,
+                  batch_end_callback, eval_end_callback,
+                  eval_batch_end_callback, monitor, sparse_row_id_fn,
+                  batches_per_dispatch, use_scan, begin_epoch, num_epoch):
+        """The per-epoch body of :meth:`fit` (wrapped by the
+        flight-recorder exception hook above)."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

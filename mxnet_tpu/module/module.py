@@ -349,6 +349,17 @@ class Module(BaseModule):
                                           shardings=shardings,
                                           group2ctx=g2c,
                                           type_dict=type_dict, **shapes)
+        # memory ledger: what this module pinned in device memory
+        from .. import xla_stats
+        scope = self._ledger_scope()
+        xla_stats.ledger_set(scope, "params", xla_stats.tree_bytes(
+            [self._exec.arg_dict[n] for n in self._param_names
+             if n in self._exec.arg_dict]))
+        xla_stats.ledger_set(scope, "grads", xla_stats.tree_bytes(
+            [g for g in self._exec.grad_dict.values() if g is not None]))
+        xla_stats.ledger_set(scope, "aux", xla_stats.tree_bytes(
+            list(self._exec.aux_dict.values())))
+        self._opt_bytes_noted = False
         from ..symbol.symbol import _graph_infer
         _, self._out_shapes, _ = _graph_infer(self._symbol, shapes)
         self.binded = True
@@ -366,6 +377,25 @@ class Module(BaseModule):
         if shared_module is not None and shared_module.params_initialized:
             self.params_initialized = True
             self._sync_params_from_devices()
+
+    def _ledger_scope(self):
+        """Memory-ledger owner label for this module: the symbol's head
+        name when it has one, else the class name."""
+        name = None
+        try:
+            name = self._symbol.name
+        except Exception:
+            pass
+        return name or type(self).__name__.lower()
+
+    def _note_optimizer_bytes(self, state_arrays):
+        """One-time optimizer-state byte accounting (first update)."""
+        if getattr(self, "_opt_bytes_noted", False):
+            return
+        from .. import xla_stats
+        xla_stats.ledger_set(self._ledger_scope(), "optimizer",
+                             xla_stats.tree_bytes(state_arrays))
+        self._opt_bytes_noted = True
 
     def _dp_shardings(self, shapes):
         """SPMD data parallelism over a multi-device context list: ONE
@@ -558,6 +588,9 @@ class Module(BaseModule):
             else:
                 for i, name, grad in live:
                     self._updater(i, grad, self._exec.arg_dict[name])
+            if self._updater is not None:
+                self._note_optimizer_bytes(
+                    list(self._updater.states.values()))
 
     def _step(self, data_batch):
         """One-dispatch train step: forward + backward + optimizer update in
@@ -588,6 +621,9 @@ class Module(BaseModule):
         outs, aux_up, new_ws, new_states, grads = step_fn(
             grad_args, other_args, aux_vals, key, lrs, wds, rescale,
             state_vals)
+        from .. import xla_stats
+        xla_stats.note_train_step(step_fn, batches=1)
+        self._note_optimizer_bytes(state_vals)
         for name, val in aux_up.items():
             exec_.aux_dict[name]._data = val
         for w, nv in zip(weights, new_ws):
@@ -660,7 +696,10 @@ class Module(BaseModule):
         # CPU backends don't implement donation (JAX warns per compile).
         donate = (7,) if getattr(self._context[0], "device_type", "cpu") \
             not in ("cpu", "cpu_pinned", "cpu_shared") else ()
-        step_fn = jax.jit(step, donate_argnums=donate)
+        from .. import xla_stats
+        step_fn = xla_stats.tracked_jit(step, "module.fused_step",
+                                        donate_argnums=donate,
+                                        lineage=id(self))
         indices = [self._param_names.index(n) for n in live_names]
         return (live_names, indices, fused, step_fn, step)
 
@@ -806,7 +845,10 @@ class Module(BaseModule):
             donate = (8,) if on_accel else ()
             if on_accel and getattr(self, "scan_donate_params", False):
                 donate = (0, 8)
-            scan_fn = jax.jit(scan_step, donate_argnums=donate)
+            from .. import xla_stats
+            scan_fn = xla_stats.tracked_jit(scan_step, "module.scan_step",
+                                            donate_argnums=donate,
+                                            lineage=id(self))
             if self._scan_plans is None:
                 self._scan_plans = {}
             self._scan_plans[plan_key] = scan_fn
@@ -823,6 +865,10 @@ class Module(BaseModule):
         key = exec_._next_key()
         ga, aux, sv, outs = scan_fn(grad_args, consts, placed, aux_vals,
                                     key, lrs, wds, rescale, state_vals)
+        from .. import xla_stats
+        # the scanned executable's FLOPs cover all K carried batches
+        xla_stats.note_train_step(scan_fn, batches=K)
+        self._note_optimizer_bytes(state_vals)
         for name, val in aux.items():
             exec_.aux_dict[name]._data = val
         # rebind EVERY carried arg (not just the updated weights): with

@@ -8,6 +8,7 @@ from __future__ import annotations
 import logging
 import re
 
+from . import telemetry
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -31,12 +32,20 @@ class Monitor:
         def stat_helper(name, array):
             if not self.activated or not self.re_prog.match(name):
                 return
+            telemetry.counter("monitor_stats_total",
+                              help="tensor stats captured by "
+                                   "monitor.Monitor").inc()
             self.queue.append((self.step, name, self.stat_func(array)))
         self.stat_helper = stat_helper
 
     def install(self, exe):
+        """Attach to an executor. Idempotent per executor: repeated
+        ``fit`` calls re-install the same monitor, and without the
+        dedupe every round appended the executor again — `tic` then
+        re-synced (and `toc` re-read) each executor once per duplicate."""
         exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        if not any(e is exe for e in self.exes):
+            self.exes.append(exe)
 
     def tic(self):
         if self.step % self.interval == 0:

@@ -42,7 +42,9 @@ def _jit_step(loss_fn, optimizer_update, donate_params):
         new_params, new_opt_state = optimizer_update(params, grads, opt_state)
         return loss, new_params, new_opt_state
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate_params else ())
+    from ..xla_stats import tracked_jit
+    return tracked_jit(step, "data_parallel.step",
+                       donate_argnums=(0, 1) if donate_params else ())
 
 
 def shard_leading_axis(mesh, axis, tree):

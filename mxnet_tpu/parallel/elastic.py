@@ -56,6 +56,16 @@ class WorkerFailure(RuntimeError):
     """Peer loss detected via the heartbeat protocol mid-run."""
 
 
+def _flight_dump(reason, error=None):
+    """Best-effort flight-recorder dump before an ``os._exit`` — the
+    post-mortem must survive even when xla_stats cannot import."""
+    try:
+        from .. import xla_stats
+        xla_stats.dump_flight_recorder(reason, error=error)
+    except Exception:   # pragma: no cover - never block the exit path
+        pass
+
+
 def _is_distributed():
     import jax
     return jax.process_count() > 1
@@ -364,6 +374,8 @@ class ElasticTrainer:
                         "elastic_watchdog_exits_total",
                         help="watchdog-initiated restart exits").inc()
                     telemetry.event("elastic.watchdog_exit", dead=dead)
+                    _flight_dump("elastic.watchdog_exit",
+                                 "%d dead node(s)" % dead)
                     telemetry.flush()  # os._exit skips atexit
                     os._exit(RESTART_EXIT_CODE)
 
@@ -461,6 +473,8 @@ class ElasticTrainer:
                                       RESTART_EXIT_CODE)
                         telemetry.event("elastic.step_exit", step=step,
                                         error=str(exc)[:200])
+                        _flight_dump("elastic.step_exit",
+                                     str(exc)[:200])
                         telemetry.flush()  # os._exit skips atexit
                         os._exit(RESTART_EXIT_CODE)
                     step, state = self._recover(state, exc)

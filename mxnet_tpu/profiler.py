@@ -144,22 +144,16 @@ def reset_stats():
 
 
 def _device_memory_lines():
-    lines = []
-    try:
-        devs = jax.devices()
-    except Exception:  # pragma: no cover
-        return lines
-    for d in devs[:8]:
-        try:
-            st = d.memory_stats()
-        except Exception:
-            st = None
-        if not st:
-            continue
-        lines.append("Device %s: bytes_in_use=%d peak_bytes_in_use=%d"
-                     % (d, st.get("bytes_in_use", 0),
-                        st.get("peak_bytes_in_use", 0)))
-    return lines
+    """Per-device allocator lines from the `xla_stats` memory ledger.
+    Backends without ``memory_stats()`` (CPU) report ZEROS instead of
+    being skipped, so the table shape — and the Prometheus
+    ``hbm_bytes_in_use`` series the ledger sets — stay continuous on
+    CPU runs."""
+    from . import xla_stats
+    return ["Device %s: bytes_in_use=%d peak_bytes_in_use=%d"
+            % (rec["device"], rec["bytes_in_use"],
+               rec["peak_bytes_in_use"])
+            for rec in xla_stats.device_memory(limit=8)]
 
 
 def dumps(reset=False, format="table"):

@@ -47,7 +47,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "snapshot", "dumps", "reset",
            "span", "event", "configure", "configured_dir", "flush",
            "write_snapshot", "host_id", "set_host_id", "read_events",
-           "to_chrome", "merge"]
+           "to_chrome", "merge", "add_tap", "remove_tap"]
 
 _lock = threading.RLock()
 _metrics = {}   # (name, label_items) -> metric
@@ -392,6 +392,32 @@ def _event_fh():
         return fh
 
 
+_taps = []   # in-process event subscribers (e.g. the flight recorder)
+
+
+def add_tap(cb):
+    """Subscribe ``cb(record_dict)`` to every event/span record, even
+    when no event-log dir is configured (`xla_stats.flight_recorder`
+    rides on this). Idempotent per callback."""
+    with _lock:
+        if cb not in _taps:
+            _taps.append(cb)
+
+
+def remove_tap(cb):
+    with _lock:
+        if cb in _taps:
+            _taps.remove(cb)
+
+
+def _tap(rec):
+    for cb in list(_taps):
+        try:
+            cb(rec)
+        except Exception:   # a broken subscriber must not break a span
+            pass
+
+
 def _emit(rec):
     # the observability layer must never take the training step down
     # with it: a full disk or deleted telemetry dir degrades to dropped
@@ -411,11 +437,14 @@ def _emit(rec):
 
 
 def event(name, **args):
-    """Record an instant event (JSONL only; no registry side effect)."""
-    _emit({"name": name, "ph": "i", "ts": time.time(),
+    """Record an instant event (JSONL + taps; no registry side
+    effect)."""
+    rec = {"name": name, "ph": "i", "ts": time.time(),
            "mono": time.monotonic(), "pid": os.getpid(),
            "host": host_id(), "tid": threading.get_ident() & 0xFFFFFF,
-           "args": args})
+           "args": args}
+    _tap(rec)
+    _emit(rec)
 
 
 class span:
@@ -446,12 +475,14 @@ class span:
         if exc is not None:
             self.attrs["error"] = "%s: %s" % (type(exc).__name__,
                                               str(exc)[:200])
-        if _state["dir"] is not None:
-            _emit({"name": self.name, "ph": "X", "ts": self._wall,
+        if _state["dir"] is not None or _taps:
+            rec = {"name": self.name, "ph": "X", "ts": self._wall,
                    "mono": self._t0, "dur": dur, "pid": os.getpid(),
                    "host": host_id(),
                    "tid": threading.get_ident() & 0xFFFFFF,
-                   "args": self.attrs})
+                   "args": self.attrs}
+            _tap(rec)
+            _emit(rec)
         return None
 
 

@@ -1,0 +1,796 @@
+"""Device & compiler observability: compile accounting, HBM memory
+ledger, MFU goodput, crash flight recorder.
+
+PR 2's telemetry (`mxnet_tpu/telemetry.py`) made the HOST side of a run
+visible — kvstore traffic, retries, checkpoint durations, fit phases.
+On a JAX/XLA stack the expensive silent failure modes live BELOW the
+host, and this module is the layer that surfaces them into the same
+registry:
+
+1. **Compile accounting** — :func:`tracked_jit` wraps every jit entry
+   point in the framework (executor forward / fused fwd+bwd, Module's
+   fused and scanned train steps, gluon hybridize, the data-parallel
+   front doors) with a shared tracker that owns a signature ->
+   executable cache:
+
+   - ``jit_compiles_total{site=}`` / ``jit_cache_hits_total{site=}`` /
+     ``jit_retraces_total{site=}`` counters (plus unlabeled totals);
+   - compile wall time in ``jit_compile_seconds{site=}`` histograms and
+     ``xla.compile`` trace events;
+   - a **retrace explainer**: on every compile after the first at a
+     site, the new abstract signature (shapes / dtypes / weak-types /
+     shardings / static args) is diffed against the previous one and
+     the log line NAMES what changed (down to the dimension), so
+     "training suddenly got slow" debugging starts from
+     ``retrace executor.forward: arg0['data']: shape (4, 10) ->
+     (8, 10) (dim 0: 4 -> 8)`` instead of a jit cache dump.
+
+   The tracker compiles ahead-of-time (``fn.lower(*args).compile()``)
+   and calls the executable directly — one compile per signature, and
+   the compiled object is the source for :func:`~TrackedJit.last_flops`
+   (``cost_analysis``) and the activation-byte ledger
+   (``memory_analysis``). Tracer inputs (a tracked function called
+   inside an outer trace, e.g. gluon's vjp path) fall through to the
+   plain jit dispatch. ``MXNET_XLA_STATS=0`` disables tracking
+   entirely; ``MXNET_XLA_STATS_AOT=0`` keeps the accounting but calls
+   through the normal jit path (no cost analysis).
+
+2. **Memory ledger** — :func:`ledger_set` byte accounting per
+   (scope, section): Module.bind records params/grads/aux, the first
+   fused update records optimizer state, and every tracked compile
+   records XLA temp (activation working set) and output bytes. Exposed
+   as ``memory_ledger_bytes{scope=,section=}`` gauges and the
+   :func:`memory_report` table. :func:`device_memory` samples PJRT
+   allocator stats into ``hbm_bytes_in_use`` / ``hbm_peak_bytes_in_use``
+   gauges — emitting ZEROS (not skipping) when the backend has no
+   ``memory_stats()`` so CPU runs keep continuous Prometheus series.
+
+3. **Goodput / MFU** — :func:`note_train_step` caches the per-batch
+   model FLOPs of the live train-step executable; :func:`goodput`
+   combines it with a batch-count window into
+   ``model_flops_per_second`` and ``mfu`` gauges
+   (``mfu = model_flops/s ÷ (peak_flops_per_device × device_count)``,
+   peak from a per-device-kind table overridable with
+   ``MXNET_PEAK_FLOPS``). Surfaced by `callback.Speedometer` log lines
+   and `bench.py` metric lines.
+
+4. **Flight recorder** — a bounded in-memory ring of recent telemetry
+   events (fed by a `telemetry` tap, so it works with NO telemetry dir
+   configured) plus last-compile/last-step metadata.
+   :meth:`FlightRecorder.dump` writes
+   ``MXNET_TELEMETRY_DIR/flightrecorder-host<h>.json``; the elastic
+   watchdog / step-exit ``os._exit`` paths, chaos worker-death, and
+   unhandled exceptions in ``Module.fit`` all dump it, so post-mortem
+   state survives kills that skip ``atexit``.
+
+Import cost: stdlib + telemetry only — jax is imported lazily inside
+functions, so the chaos/elastic exit paths can reach the recorder even
+from processes that must stay stdlib-only at import.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+from . import telemetry
+
+__all__ = ["TrackedJit", "tracked_jit", "aot_compile", "last_retrace",
+           "explain_signature_change", "ledger_set", "ledger",
+           "tree_bytes", "device_memory", "live_buffers", "memory_report",
+           "peak_flops_per_device", "peak_flops_total", "note_train_step",
+           "flops_per_batch", "goodput", "publish_goodput", "mfu_of",
+           "FlightRecorder", "flight_recorder", "reset"]
+
+logger = logging.getLogger("mxnet_tpu.xla_stats")
+
+_lock = threading.RLock()
+_sites = {}    # (site, lineage) -> {"compiles": int, "sig": dict or None}
+_ledger = {}   # (scope, section) -> bytes
+_step = {"flops_per_batch": 0.0, "site": None, "batches": 0,
+         "updated": 0.0}
+_state = {"last_retrace": None}
+
+
+def _enabled():
+    return os.environ.get("MXNET_XLA_STATS", "1") != "0"
+
+
+def _aot_enabled():
+    return os.environ.get("MXNET_XLA_STATS_AOT", "1") != "0"
+
+
+def reset():
+    """Drop per-site compile state, the ledger, goodput state, and the
+    flight-recorder ring (tests). Registry metrics are NOT touched —
+    pair with ``telemetry.reset()``."""
+    with _lock:
+        _sites.clear()
+        _ledger.clear()
+        _step.update(flops_per_batch=0.0, site=None, batches=0,
+                     updated=0.0)
+        _state["last_retrace"] = None
+    flight_recorder.clear()
+
+
+# ---------------------------------------------------------------------------
+# Abstract signatures: fast hashable keys + printable descriptions
+# ---------------------------------------------------------------------------
+
+def _describe_leaf(x):
+    """Hashable description of one argument leaf. Array-likes are
+    abstracted to (shape, dtype, weak_type, sharding) — values never
+    enter, so hyperparameters that change per step cannot fake a
+    retrace. Python scalars are type-only (jit traces them)."""
+    if x is None:
+        return ("none",)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = bool(getattr(getattr(x, "aval", None), "weak_type", False))
+        sharding = getattr(x, "sharding", None)
+        return ("array", tuple(shape), str(dtype), weak, sharding)
+    if isinstance(x, (bool, int, float, complex, str, bytes)):
+        return ("scalar", type(x).__name__)
+    return ("opaque", type(x).__name__)
+
+
+def _key_leaf(x):
+    """Per-call fast variant of :func:`_describe_leaf`: same abstraction
+    but keeps dtype/sharding as hashable OBJECTS (str(dtype) alone costs
+    ~6us a leaf, which dominates dispatch at ResNet parameter counts)."""
+    if x is None:
+        return ("none",)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        aval = getattr(x, "aval", None)
+        weak = aval.weak_type if aval is not None else False
+        return ("array", tuple(shape), dtype, weak,
+                getattr(x, "sharding", None))
+    if isinstance(x, (bool, int, float, complex, str, bytes)):
+        return ("scalar", type(x).__name__)
+    return ("opaque", type(x).__name__)
+
+
+def _key_of(obj):
+    if isinstance(obj, dict):
+        try:
+            items = sorted(obj.items())
+        except TypeError:   # mixed/unorderable keys
+            items = sorted(obj.items(), key=lambda kv: str(kv[0]))
+        return ("d",) + tuple((k, _key_of(v)) for k, v in items)
+    if isinstance(obj, (list, tuple)):
+        return ("t",) + tuple(_key_of(v) for v in obj)
+    return _key_leaf(obj)
+
+
+def _describe_args(args, static):
+    """{path: leaf description} over the positional args — built only on
+    cache miss, for the retrace explainer."""
+    entries = {}
+
+    def walk(prefix, obj):
+        if isinstance(obj, dict):
+            for k in sorted(obj, key=str):
+                walk("%s[%r]" % (prefix, k), obj[k])
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                walk("%s[%d]" % (prefix, i), v)
+        else:
+            entries[prefix] = _describe_leaf(obj)
+
+    for i, a in enumerate(args):
+        if i in static:
+            entries["arg%d(static)" % i] = ("static", repr(a))
+        else:
+            walk("arg%d" % i, a)
+    return entries
+
+
+def _fmt_desc(d):
+    if d[0] == "array":
+        out = "shape %s dtype %s" % (tuple(d[1]), d[2])
+        if d[3]:
+            out += " (weak)"
+        return out
+    if d[0] == "static":
+        return "static %s" % d[1]
+    if d[0] == "scalar":
+        return "python %s" % d[1]
+    return d[0]
+
+
+def _diff_desc(a, b):
+    if a[0] == "array" and b[0] == "array":
+        parts = []
+        if a[1] != b[1]:
+            msg = "shape %s -> %s" % (tuple(a[1]), tuple(b[1]))
+            if len(a[1]) == len(b[1]):
+                dims = ", ".join("dim %d: %s -> %s" % (i, x, y)
+                                 for i, (x, y) in enumerate(zip(a[1], b[1]))
+                                 if x != y)
+                msg += " (%s)" % dims
+            parts.append(msg)
+        if a[2] != b[2]:
+            parts.append("dtype %s -> %s" % (a[2], b[2]))
+        if a[3] != b[3]:
+            parts.append("weak_type %s -> %s" % (a[3], b[3]))
+        if a[4] != b[4]:
+            parts.append("sharding %s -> %s" % (a[4], b[4]))
+        return ", ".join(parts) or "changed"
+    if a[0] == "static" and b[0] == "static":
+        return "static value %s -> %s" % (a[1], b[1])
+    return "%s -> %s" % (_fmt_desc(a), _fmt_desc(b))
+
+
+def explain_signature_change(old, new):
+    """Human-readable diff of two ``_describe_args`` signatures: names
+    every path whose abstract description changed, down to the dimension
+    for rank-preserving shape changes."""
+    parts = []
+    for k in sorted(set(old) | set(new)):
+        a, b = old.get(k), new.get(k)
+        if a == b:
+            continue
+        if a is None:
+            parts.append("%s: new input (%s)" % (k, _fmt_desc(b)))
+        elif b is None:
+            parts.append("%s: input removed (was %s)" % (k, _fmt_desc(a)))
+        else:
+            parts.append("%s: %s" % (k, _diff_desc(a, b)))
+    return "; ".join(parts) or \
+        "no signature change detected (new code object or closure)"
+
+
+def last_retrace():
+    """Metadata of the most recent retrace: ``{"site", "reason",
+    "compiles", "time"}`` or None."""
+    with _lock:
+        return dict(_state["last_retrace"]) if _state["last_retrace"] \
+            else None
+
+
+# ---------------------------------------------------------------------------
+# Compile tracking
+# ---------------------------------------------------------------------------
+
+def _count(name, site, help=""):
+    telemetry.counter(name, help=help).inc()
+    telemetry.counter(name, help=help, site=site).inc()
+
+
+def _flops_of(compiled):
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    try:
+        f = cost.get("flops")
+    except AttributeError:
+        return None
+    # XLA reports negative flops (-1/-2) for computations it cannot
+    # cost (callbacks, custom calls): that is "unknown", not a figure
+    return float(f) if f is not None and f > 0 else None
+
+
+def _memory_of(compiled):
+    try:
+        m = compiled.memory_analysis()
+        return {"argument_bytes": int(m.argument_size_in_bytes),
+                "output_bytes": int(m.output_size_in_bytes),
+                "temp_bytes": int(m.temp_size_in_bytes),
+                "code_bytes": int(m.generated_code_size_in_bytes)}
+    except Exception:
+        return None
+
+
+class _Entry:
+    __slots__ = ("compiled", "flops", "memory")
+
+    def __init__(self, compiled, flops, memory):
+        self.compiled = compiled
+        self.flops = flops
+        self.memory = memory
+
+
+class TrackedJit:
+    """A ``jax.jit`` with compile accounting (see module docstring).
+
+    Owns a signature -> compiled-executable cache. A miss is a compile
+    (and, beyond the lineage's first, a retrace with an explained
+    diff); a hit calls the cached executable. Tracer inputs and keyword
+    calls fall through to the plain jit dispatch path.
+
+    ``lineage`` scopes retrace detection: wrappers sharing (site,
+    lineage) — e.g. the executors a Module rebinds over one Symbol, or
+    the rebuilt jits of one gluon block — diff against each other, so a
+    reshape-triggered recompile IS reported as a retrace; wrappers with
+    different lineages (two unrelated models hitting the same site in
+    one process) never cross-diff, and the second model's first compile
+    is just a compile. Default: this wrapper instance only.
+    """
+
+    def __init__(self, fun, site, static_argnums=(), lineage=None,
+                 **jit_kwargs):
+        import jax
+        if isinstance(static_argnums, int):
+            static_argnums = (static_argnums,)
+        self.site = site
+        self._lineage = (site, lineage if lineage is not None
+                         else id(self))
+        self._static = frozenset(static_argnums)
+        self._fn = jax.jit(fun, static_argnums=tuple(static_argnums),
+                           **jit_kwargs)
+        self._cache = {}
+        self._compile_lock = threading.Lock()
+        self.last_flops = None
+        self.last_memory = None
+
+    # jax.jit API passthroughs used by callers/tests
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        import jax
+        if kwargs or not jax.core.trace_state_clean():
+            # called inside an outer trace (vjp/scan over a tracked fn)
+            # or with kwargs: the plain dispatch path handles both
+            return self._fn(*args, **kwargs)
+        key = tuple(("s", a) if i in self._static and _hashable(a)
+                    else _key_of(a) for i, a in enumerate(args))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile_entry(key, args)
+        else:
+            _count("jit_cache_hits_total", self.site,
+                   help="tracked jit calls served by a cached executable")
+        self.last_flops = entry.flops
+        self.last_memory = entry.memory
+        if entry.compiled is None:
+            return self._fn(*args)
+        call_args = [a for i, a in enumerate(args) if i not in self._static]
+        try:
+            return entry.compiled(*call_args)
+        except (TypeError, ValueError) as exc:
+            # argument validation the signature key did not capture
+            # (e.g. an uncommitted array moved device): disable AOT for
+            # this signature and let jit's own cache take over
+            logger.warning("xla_stats[%s]: compiled call rejected (%s); "
+                           "falling back to jit dispatch", self.site, exc)
+            _count("jit_aot_fallbacks_total", self.site,
+                   help="tracked executables rejected at call time")
+            entry.compiled = None
+            return self._fn(*args)
+
+    def _compile_entry(self, key, args):
+        with self._compile_lock:
+            entry = self._cache.get(key)
+            if entry is not None:   # raced with another thread
+                _count("jit_cache_hits_total", self.site)
+                return entry
+            sig = _describe_args(args, self._static)
+            with _lock:
+                st = _sites.setdefault(self._lineage,
+                                       {"compiles": 0, "sig": None})
+                st["compiles"] += 1
+                n = st["compiles"]
+                prev = st["sig"]
+                st["sig"] = sig
+            reason = None
+            if prev is not None:
+                reason = explain_signature_change(prev, sig)
+                with _lock:
+                    _state["last_retrace"] = {
+                        "site": self.site, "reason": reason,
+                        "compiles": n, "time": time.time()}
+                _count("jit_retraces_total", self.site,
+                       help="compiles beyond the first at a jit site")
+                logger.warning("jit retrace [%s] (compile #%d): %s",
+                               self.site, n, reason)
+            _count("jit_compiles_total", self.site,
+                   help="XLA compiles at tracked jit sites")
+            t0 = time.perf_counter()
+            compiled = None
+            if _aot_enabled():
+                try:
+                    compiled = self._fn.lower(*args).compile()
+                except Exception as exc:
+                    # trace/compile errors must surface through the
+                    # plain call below, with jit's own diagnostics
+                    logger.debug("xla_stats[%s]: AOT compile failed "
+                                 "(%s); deferring to jit dispatch",
+                                 self.site, exc)
+            dur = time.perf_counter() - t0
+            flops = _flops_of(compiled) if compiled is not None else None
+            memory = _memory_of(compiled) if compiled is not None else None
+            telemetry.histogram("jit_compile_seconds",
+                                help="lower+compile wall time per tracked "
+                                     "jit site", site=self.site).observe(dur)
+            telemetry.event("xla.compile", site=self.site, seconds=dur,
+                            compile_no=n, flops=flops,
+                            retrace=reason)
+            meta = {"site": self.site, "seconds": dur, "compile_no": n,
+                    "flops": flops, "memory": memory, "time": time.time(),
+                    "retrace": reason}
+            flight_recorder.last["compile"] = meta
+            if memory is not None:
+                ledger_set(self.site, "xla_temp", memory["temp_bytes"])
+                ledger_set(self.site, "xla_output", memory["output_bytes"])
+            entry = _Entry(compiled, flops, memory)
+            self._cache[key] = entry
+            return entry
+
+
+def _hashable(x):
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+def tracked_jit(fun, site, static_argnums=(), lineage=None, **jit_kwargs):
+    """``jax.jit`` with compile accounting under ``site`` (retrace
+    detection scoped by ``lineage`` — see :class:`TrackedJit`); plain
+    ``jax.jit`` when tracking is disabled (``MXNET_XLA_STATS=0``)."""
+    if not _enabled():
+        import jax
+        return jax.jit(fun, static_argnums=static_argnums, **jit_kwargs)
+    return TrackedJit(fun, site, static_argnums=static_argnums,
+                      lineage=lineage, **jit_kwargs)
+
+
+def aot_compile(jitted, *args):
+    """Best-effort AOT compile of an (already jitted) callable for
+    ``args``. Returns ``(compiled, info)`` where ``info`` carries
+    ``flops``/``memory``; ``(None, None)`` when lowering fails (caller
+    keeps using the jitted function)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception as exc:
+        logger.debug("aot_compile failed: %s", exc)
+        return None, None
+    return compiled, {"flops": _flops_of(compiled),
+                      "memory": _memory_of(compiled)}
+
+
+# ---------------------------------------------------------------------------
+# Memory ledger
+# ---------------------------------------------------------------------------
+
+def ledger_set(scope, section, nbytes):
+    """Record that ``scope`` (a module/site name) holds ``nbytes`` in
+    ``section`` (params/grads/aux/optimizer/xla_temp/...). Gauged as
+    ``memory_ledger_bytes{scope=,section=}``."""
+    nbytes = int(nbytes)
+    with _lock:
+        _ledger[(str(scope), str(section))] = nbytes
+    telemetry.gauge("memory_ledger_bytes",
+                    help="framework-accounted bytes by owner and section",
+                    scope=scope, section=section).set(nbytes)
+
+
+def ledger():
+    """Copy of the ledger: ``{(scope, section): bytes}``."""
+    with _lock:
+        return dict(_ledger)
+
+
+def tree_bytes(tree):
+    """Total payload bytes of the array leaves of ``tree`` (NDArrays are
+    unwrapped; leaves without ``nbytes`` count 0)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf = getattr(leaf, "_data", leaf)   # NDArray -> jax array
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def device_memory(limit=64):
+    """Per-device allocator stats as dicts, gauged as
+    ``hbm_bytes_in_use{device=}`` / ``hbm_peak_bytes_in_use{device=}``.
+    Backends without ``memory_stats()`` (CPU) report ZEROS — the series
+    stay continuous instead of disappearing on CPU runs."""
+    out = []
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception:
+        return out
+    for d in devs[:limit]:
+        st = None
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        st = st or {}
+        rec = {"device": str(d),
+               "kind": getattr(d, "device_kind", "unknown"),
+               "bytes_in_use": int(st.get("bytes_in_use", 0) or 0),
+               "peak_bytes_in_use": int(st.get("peak_bytes_in_use", 0)
+                                        or 0),
+               "bytes_limit": int(st.get("bytes_limit", 0) or 0)}
+        telemetry.gauge("hbm_bytes_in_use",
+                        help="PJRT allocator bytes in use (0 when the "
+                             "backend has no memory_stats)",
+                        device=rec["device"]).set(rec["bytes_in_use"])
+        telemetry.gauge("hbm_peak_bytes_in_use",
+                        help="PJRT allocator peak bytes in use",
+                        device=rec["device"]).set(rec["peak_bytes_in_use"])
+        out.append(rec)
+    return out
+
+
+def live_buffers():
+    """(count, bytes) over every live jax array in the process; gauged
+    as ``live_buffer_count`` / ``live_buffer_bytes``."""
+    try:
+        import jax
+        arrs = jax.live_arrays()
+    except Exception:
+        return 0, 0
+    n = len(arrs)
+    b = 0
+    for a in arrs:
+        try:
+            b += int(a.nbytes)
+        except Exception:
+            pass
+    telemetry.gauge("live_buffer_count",
+                    help="live jax arrays in the process").set(n)
+    telemetry.gauge("live_buffer_bytes",
+                    help="payload bytes of live jax arrays").set(b)
+    return n, b
+
+
+def memory_report():
+    """Rendered table: ledger sections, live buffers, per-device
+    allocator stats (`profiler.dumps` embeds the device lines)."""
+    rows = sorted(ledger().items())
+    out = ["Memory ledger (framework-accounted bytes)."]
+    hdr = "%-28s %-12s %16s" % ("Scope", "Section", "Bytes")
+    out += [hdr, "-" * len(hdr)]
+    for (scope, section), nbytes in rows:
+        out.append("%-28s %-12s %16d" % (scope[:28], section[:12], nbytes))
+    if not rows:
+        out.append("(empty)")
+    n, b = live_buffers()
+    out += ["", "Live device buffers: %d arrays, %d bytes" % (n, b)]
+    devs = device_memory()
+    if devs:
+        out.append("")
+        for rec in devs:
+            out.append("Device %s: bytes_in_use=%d peak_bytes_in_use=%d"
+                       % (rec["device"], rec["bytes_in_use"],
+                          rec["peak_bytes_in_use"]))
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Goodput / MFU
+# ---------------------------------------------------------------------------
+
+#: Dense per-chip peak FLOP/s by device-kind substring (bf16/fp16 where
+#: the matrix unit supports it). Matched case-insensitively, longest
+#: name first; override with MXNET_PEAK_FLOPS (per device).
+PEAK_FLOPS_BY_KIND = {
+    "tpu v2": 45e12,
+    "tpu v3": 123e12,
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v6 lite": 918e12,
+    "tpu v6e": 918e12,
+    "a100": 312e12,
+    "h100": 989e12,
+    "h200": 989e12,
+    "v100": 125e12,
+}
+
+
+def peak_flops_per_device():
+    """Peak FLOP/s of one local device: ``MXNET_PEAK_FLOPS`` env if set,
+    else the device-kind table; 0.0 when unknown (MFU reads 0 rather
+    than inventing a roofline)."""
+    env = os.environ.get("MXNET_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            logger.warning("bad MXNET_PEAK_FLOPS=%r ignored", env)
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 0.0
+    for name in sorted(PEAK_FLOPS_BY_KIND, key=len, reverse=True):
+        if name in kind:
+            return PEAK_FLOPS_BY_KIND[name]
+    return 0.0
+
+
+def peak_flops_total():
+    """Aggregate peak over every device of the run (global device count,
+    so multi-host MFU uses the whole pod's roofline)."""
+    per = peak_flops_per_device()
+    if not per:
+        return 0.0
+    try:
+        import jax
+        return per * max(1, jax.device_count())
+    except Exception:
+        return per
+
+
+def note_train_step(source, batches=1):
+    """Record the FLOPs of the live train-step executable. ``source`` is
+    a :class:`TrackedJit` (its ``last_flops`` covers the whole dispatch)
+    or a raw FLOP count; ``batches`` is how many optimizer steps one
+    dispatch carries (K for the scanned step). Feeds
+    ``model_flops_total`` and the per-batch figure :func:`goodput`
+    rates."""
+    flops = source if isinstance(source, (int, float)) \
+        else getattr(source, "last_flops", None)
+    if not flops or flops <= 0:
+        return
+    site = getattr(source, "site", None)
+    batches = max(1, int(batches))
+    with _lock:
+        _step.update(flops_per_batch=float(flops) / batches, site=site,
+                     batches=batches, updated=time.monotonic())
+    telemetry.counter("model_flops_total",
+                      help="model FLOPs executed by tracked train "
+                           "steps").inc(float(flops))
+    flight_recorder.last["step"] = {
+        "site": site, "flops_per_batch": float(flops) / batches,
+        "batches": batches, "time": time.time(),
+        "fit_batches_total": telemetry.counter("fit_batches_total").value}
+
+
+def flops_per_batch():
+    """Model FLOPs of one train batch per the last noted executable
+    (0.0 until a tracked train step ran)."""
+    with _lock:
+        return _step["flops_per_batch"]
+
+
+def mfu_of(model_flops_per_second):
+    """Model-FLOPs-utilization for a FLOP/s rate: rate / total peak
+    (0.0 when the device kind has no known roofline)."""
+    peak = peak_flops_total()
+    return model_flops_per_second / peak if peak else 0.0
+
+
+def publish_goodput(model_flops_per_second):
+    """Set the ``model_flops_per_second`` / ``mfu`` gauges for a
+    measured FLOP/s rate (the ONE publication point — Speedometer,
+    bench.py, and :func:`goodput` all land here). Returns the result
+    dict."""
+    mfu = mfu_of(model_flops_per_second)
+    telemetry.gauge("model_flops_per_second",
+                    help="model FLOPs per wall second over the last "
+                         "measured window").set(model_flops_per_second)
+    telemetry.gauge("mfu",
+                    help="model FLOPs utilization vs the device peak "
+                         "(0 when the peak is unknown)").set(mfu)
+    return {"model_flops_per_second": model_flops_per_second, "mfu": mfu}
+
+
+def goodput(batches, elapsed):
+    """Rates for a window of ``batches`` train batches over ``elapsed``
+    seconds: ``{"model_flops_per_second", "mfu"}`` (also sets the two
+    gauges via :func:`publish_goodput`), or None when no FLOPs figure
+    is known yet or the window is empty."""
+    fpb = flops_per_batch()
+    if not fpb or elapsed <= 0 or batches <= 0:
+        return None
+    return publish_goodput(fpb * batches / elapsed)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry events + last compile/step
+    metadata, dumpable as one JSON file from crash paths.
+
+    Fed by a `telemetry` tap, so it records even when no telemetry dir
+    is configured (the ring is memory-only until :meth:`dump`). Size:
+    ``MXNET_FLIGHT_RECORDER_EVENTS`` (default 256)."""
+
+    def __init__(self, maxlen=None):
+        if maxlen is None:
+            try:
+                maxlen = int(os.environ.get(
+                    "MXNET_FLIGHT_RECORDER_EVENTS", "256"))
+            except ValueError:
+                maxlen = 256
+        self._ring = deque(maxlen=max(8, maxlen))
+        self._lock = threading.Lock()
+        self.last = {"compile": None, "step": None}
+        self.dumps_written = 0
+
+    def record(self, rec):
+        with self._lock:
+            self._ring.append(rec)
+
+    def events(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+        self.last = {"compile": None, "step": None}
+
+    def dump(self, reason="", path=None, error=None):
+        """Write the post-mortem JSON; returns the path, or None when no
+        destination exists (no telemetry dir and no explicit path) or
+        the write failed — a crash path must never crash harder because
+        the disk is gone."""
+        try:
+            if path is None:
+                d = telemetry.configured_dir() or \
+                    os.environ.get("MXNET_TELEMETRY_DIR")
+                if not d:
+                    return None
+                path = os.path.join(
+                    d, "flightrecorder-host%d.json" % telemetry.host_id())
+            doc = {
+                "host": telemetry.host_id(),
+                "pid": os.getpid(),
+                "reason": reason,
+                "error": error,
+                "dumped_at": time.time(),
+                "dumped_mono": time.monotonic(),
+                "last_compile": self.last["compile"],
+                "last_step": self.last["step"],
+                "events": self.events(),
+                "metrics": telemetry.snapshot(),
+            }
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            tmp = "%s.tmp%d" % (path, os.getpid())
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, default=str)
+            os.replace(tmp, path)   # readers never see a torn dump
+            self.dumps_written += 1
+            telemetry.counter("flightrecorder_dumps_total",
+                              help="flight-recorder post-mortem dumps "
+                                   "written").inc()
+            return path
+        except Exception:   # pragma: no cover - dying process, bad disk
+            return None
+
+
+flight_recorder = FlightRecorder()
+telemetry.add_tap(flight_recorder.record)
+
+
+def dump_flight_recorder(reason, error=None):
+    """Convenience for exit paths: dump and swallow everything."""
+    try:
+        return flight_recorder.dump(reason=reason, error=error)
+    except Exception:   # pragma: no cover
+        return None
+
+
+# Register the compile-accounting series at import so every process that
+# imports the framework exposes them (as zeros) in Prometheus snapshots,
+# whether or not a tracked jit ever ran.
+for _name, _help in (
+        ("jit_compiles_total", "XLA compiles at tracked jit sites"),
+        ("jit_cache_hits_total",
+         "tracked jit calls served by a cached executable"),
+        ("jit_retraces_total", "compiles beyond the first at a jit site")):
+    telemetry.counter(_name, help=_help)
+del _name, _help

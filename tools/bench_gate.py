@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Benchmark regression gate: compare a fresh bench run against the
+best recorded history and fail on a >10% regression of the TRAIN
+north-star metric.
+
+History sources (all optional, merged):
+  - ``BENCH_r*.json`` / ``BENCH_EXTRA.json`` round records — both the
+    ``parsed`` record and every JSON metric line embedded in ``tail``;
+  - ``BASELINE.json`` — any numeric entries under ``published``
+    keyed by metric name.
+
+The fresh run is bench.py's output: one JSON object per line
+({"metric", "value", ...}); non-JSON lines are ignored, so a captured
+log can be gated as-is.
+
+Exit status: 0 = pass (or nothing gateable), 1 = regression. The gate
+is lenient by default when the runs are not comparable: a run with no
+record of the gated metric, no recorded history, or a CPU run gated
+against accelerator history (the ``platform`` field bench.py emits)
+all warn and pass — ``--strict`` turns each of those into a failure.
+
+Usage:
+    python bench.py | tee run.jsonl
+    python tools/bench_gate.py run.jsonl            # vs repo history
+    python tools/bench_gate.py run.jsonl --threshold 0.05
+    python bench.py --gate                          # self-gating run
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN_METRIC = "resnet50_train_imgs_per_sec_bf16_bs128"
+INFER_METRIC = "resnet50_infer_imgs_per_sec_bs32"
+DEFAULT_THRESHOLD = 0.10
+
+
+def parse_lines(lines):
+    """JSON metric records out of arbitrary output lines."""
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    return out
+
+
+def _numeric(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def load_history(history_dir=None):
+    """{metric: [(value, source), ...]} from the recorded rounds."""
+    history_dir = history_dir or REPO
+    out = {}
+
+    def add(metric, value, source):
+        if metric and _numeric(value):
+            out.setdefault(metric, []).append((float(value), source))
+
+    paths = sorted(glob.glob(os.path.join(history_dir, "BENCH_*.json")))
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, list):   # BENCH_EXTRA.json: a record array
+            for rec in doc:
+                if isinstance(rec, dict):
+                    add(rec.get("metric"), rec.get("value"), name)
+            continue
+        if not isinstance(doc, dict):
+            continue
+        parsed = doc.get("parsed") or {}
+        if isinstance(parsed, dict):
+            add(parsed.get("metric"), parsed.get("value"), name)
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for rec in parse_lines(tail.splitlines()):
+                add(rec.get("metric"), rec.get("value"), name)
+    base = os.path.join(history_dir, "BASELINE.json")
+    if os.path.exists(base):
+        try:
+            with open(base, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            for metric, value in (doc.get("published") or {}).items():
+                add(metric, value, "BASELINE.json")
+        except (OSError, ValueError):
+            pass
+    # dedupe per (metric, source): keep the best value each source saw
+    for metric, vals in out.items():
+        best = {}
+        for v, src in vals:
+            if src not in best or v > best[src]:
+                best[src] = v
+        out[metric] = sorted(((v, s) for s, v in best.items()),
+                             reverse=True)
+    return out
+
+
+def _run_platform(records):
+    for rec in records:
+        p = rec.get("platform")
+        if p:
+            return p
+    return None
+
+
+def gate_records(records, history_dir=None, metric=None,
+                 threshold=DEFAULT_THRESHOLD, strict=False, out=sys.stdout):
+    """Gate already-parsed run records; returns the process exit code."""
+    history = load_history(history_dir)
+
+    def say(status, detail, **extra):
+        line = dict({"metric": "bench_gate", "status": status,
+                     "detail": detail}, **extra)
+        out.write(json.dumps(line) + "\n")
+
+    by_metric = {}
+    for rec in records:
+        if _numeric(rec.get("value")):
+            by_metric[rec["metric"]] = float(rec["value"])  # last wins
+
+    if metric is None:
+        # the TRAIN north-star when the run produced it, else the
+        # inference headline (an --infer-only or CPU run)
+        metric = TRAIN_METRIC if TRAIN_METRIC in by_metric else (
+            INFER_METRIC if INFER_METRIC in by_metric else TRAIN_METRIC)
+
+    if metric not in by_metric:
+        say("skip" if not strict else "fail",
+            "run has no %r record to gate" % metric)
+        return 1 if strict else 0
+    value = by_metric[metric]
+
+    hist = history.get(metric) or []
+    if not hist:
+        say("skip" if not strict else "fail",
+            "no recorded history for %r under %s"
+            % (metric, history_dir or REPO), value=value)
+        return 1 if strict else 0
+    best, best_src = hist[0]
+    floor = best * (1.0 - threshold)
+
+    if value >= floor:
+        say("pass", "%s=%.2f vs best %.2f (%s); floor %.2f"
+            % (metric, value, best, best_src, floor),
+            value=value, best=best, floor=floor)
+        return 0
+
+    platform = _run_platform(records)
+    if platform == "cpu" and not strict:
+        # recorded history comes from accelerator rounds; a CPU fallback
+        # run regressing against it is an environment mismatch, not a
+        # code regression
+        say("skip", "%s=%.2f is below floor %.2f but the run executed "
+            "on cpu while history was recorded on an accelerator; use "
+            "--strict to fail anyway" % (metric, value, floor),
+            value=value, best=best, floor=floor)
+        return 0
+
+    say("fail", "%s regressed: %.2f < floor %.2f (best %.2f from %s, "
+        "threshold %.0f%%)" % (metric, value, floor, best, best_src,
+                               threshold * 100),
+        value=value, best=best, floor=floor)
+    return 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run", help="bench output file (JSON lines); "
+                    "'-' reads stdin")
+    ap.add_argument("--history", default=None,
+                    help="directory holding BENCH_r*.json / BASELINE.json "
+                         "(default: the repo root)")
+    ap.add_argument("--metric", default=None,
+                    help="metric to gate (default: the TRAIN north-star, "
+                         "falling back to the inference headline)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="allowed fractional regression (default 0.10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail (not skip) on missing metric/history or "
+                         "platform mismatch")
+    args = ap.parse_args(argv)
+    if args.run == "-":
+        lines = sys.stdin.read().splitlines()
+    else:
+        with open(args.run, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    return gate_records(parse_lines(lines), history_dir=args.history,
+                        metric=args.metric, threshold=args.threshold,
+                        strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
