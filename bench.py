@@ -48,18 +48,49 @@ def bench_train():
     can't skew the inference measurement above).  Any failure degrades to
     a stderr note; the inference line already printed.
     """
-    repo = os.path.dirname(os.path.abspath(__file__))
-    sys.path.insert(0, os.path.join(repo, "tools"))
     try:
-        import bench_all
-        rec = bench_all.bench_resnet50_train()
+        rec = tools_import("bench_all").bench_resnet50_train()
     except Exception as e:
         sys.stderr.write("train benchmark failed: %r\n" % (e,))
         return
     emit(rec)
 
 
+def tools_import(name):
+    """Import a module out of the repo's tools/ dir (idempotent path
+    setup shared by the train/serve gate paths)."""
+    import importlib
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools")
+    if path not in sys.path:
+        sys.path.insert(0, path)
+    return importlib.import_module(name)
+
+
+def run_gate(metric=None):
+    """Gate this run's RECORDS against the repo history; exits."""
+    raise SystemExit(tools_import("bench_gate").gate_records(
+        RECORDS, metric=metric))
+
+
+def bench_serve():
+    """--serve mode: closed+open-loop load against the dynamic-batching
+    inference engine (`tools/serve_bench.py`), emitted as the same JSON
+    metric lines as the train/infer benches so `--gate` and the BENCH
+    history tooling parse them unchanged."""
+    for rec in tools_import("serve_bench").bench_records():
+        emit(rec)
+
+
 def main():
+    if "--serve" in sys.argv:
+        bench_serve()
+        write_telemetry_snapshot()
+        if "--gate" in sys.argv:
+            # gate the serving headline, not the TRAIN metric this run
+            # never emitted (which would skip-pass unconditionally)
+            run_gate("serving_closed_rps")
+        return
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -146,10 +177,7 @@ def main():
         bench_train()
     write_telemetry_snapshot()
     if "--gate" in sys.argv:
-        sys.path.insert(0, os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "tools"))
-        import bench_gate
-        raise SystemExit(bench_gate.gate_records(RECORDS))
+        run_gate()
 
 
 def write_goodput(info, calls, dt):

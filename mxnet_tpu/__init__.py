@@ -62,6 +62,7 @@ from . import parallel
 from . import rtc
 from . import predict
 from .predict import Predictor
+from . import serving  # dynamic-batching inference engine + HTTP server
 from . import operator
 from . import contrib
 from .attribute import AttrScope

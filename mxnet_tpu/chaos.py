@@ -52,6 +52,12 @@ SITES = {
                             "written but before the commit marker — a "
                             "torn checkpoint",
     "step.fail": "ChaosError from inside the training step",
+    "serving.slow_request": "stall a serving replica worker for VALUE "
+                            "seconds (default 0.5) before it computes a "
+                            "batch — a straggler device",
+    "serving.worker_death": "kill a serving replica worker thread at the "
+                            "batch boundary — the in-flight batch must "
+                            "fail cleanly and the worker respawn",
 }
 
 #: exit code used by an injected worker death (distinct from the elastic

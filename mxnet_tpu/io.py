@@ -15,6 +15,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 import queue as _queue
 from collections import namedtuple
 
@@ -302,6 +303,7 @@ class PrefetchingIter(DataIter):
         self._queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = None
+        self._error = None
         self.current_batch = None
         self._start()
 
@@ -319,14 +321,32 @@ class PrefetchingIter(DataIter):
         return [DataDesc(self.rename_label[0].get(d.name, d.name), d.shape, d.dtype)
                 for d in self.iters[0].provide_label]
 
+    def _put(self, queue, item):
+        """Stop-aware put: a producer blocked on a full queue re-checks
+        ``_stop`` every 50 ms, so ``reset()`` can always shake it loose —
+        a plain blocking ``put`` could outlive the 5 s join and keep
+        feeding the discarded queue forever. Returns False on stop."""
+        while not self._stop.is_set():
+            try:
+                queue.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
     def _producer(self):
+        queue = self._queue
         try:
             for batch in self.iters[0]:
-                if self._stop.is_set():
+                if not self._put(queue, batch):
                     return
-                self._queue.put(batch)
+        except Exception as exc:   # noqa: BLE001 - re-raised on consumer
+            # a mid-epoch crash of the wrapped iterator must surface in
+            # iter_next(), NOT masquerade as a clean end-of-epoch (silent
+            # data truncation)
+            self._error = exc
         finally:
-            self._queue.put(None)
+            self._put(queue, None)
 
     def _start(self):
         self._stop.clear()
@@ -335,12 +355,33 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         self._stop.set()
+        # drain so a producer blocked in put() gets queue room OR sees
+        # _stop on its next 50 ms re-check; repeat until it exits. The
+        # budget is generous (a producer stuck inside the wrapped
+        # iterator's next() — slow storage — only re-checks _stop once
+        # that call returns) and tunable for pathological backends.
         try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+            budget = float(os.environ.get("MXNET_PREFETCH_JOIN_TIMEOUT",
+                                          "30"))
+        except ValueError:
+            import warnings
+            warnings.warn("bad MXNET_PREFETCH_JOIN_TIMEOUT=%r ignored"
+                          % os.environ["MXNET_PREFETCH_JOIN_TIMEOUT"])
+            budget = 30.0
+        deadline = time.monotonic() + budget
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "PrefetchingIter.reset: producer thread did not "
+                    "exit within %gs (MXNET_PREFETCH_JOIN_TIMEOUT); "
+                    "the wrapped iterator is wedged" % budget)
+        self._error = None
         self.iters[0].reset()
         self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
@@ -348,6 +389,9 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         batch = self._queue.get()
         if batch is None:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
             return False
         self.current_batch = batch
         return True

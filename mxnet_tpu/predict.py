@@ -37,6 +37,16 @@ from .symbol import symbol as _symbol
 __all__ = ["Predictor"]
 
 
+def _shares_buffer(name, ex, other):
+    """Whether ``name`` is bound to the SAME NDArray in both executors
+    (simple_bind's shared_exec reuses buffers when shapes match)."""
+    if name in ex.arg_dict:
+        return ex.arg_dict[name] is other.arg_dict.get(name)
+    if name in ex.aux_dict:
+        return ex.aux_dict[name] is other.aux_dict.get(name)
+    return False
+
+
 class Predictor:
     """Inference-only executor over (symbol JSON, params blob).
 
@@ -98,14 +108,18 @@ class Predictor:
                 params[k] = v
         return params
 
-    def _bind(self):
+    def _bind(self, shared_exec=None):
         self._settable = None  # _input_names() cache: recompute per bind
         shapes = dict(self._input_shapes)
         for name in self._symbol.list_arguments():
             if name in self._params and name not in shapes:
                 shapes[name] = self._params[name].shape
-        ex = self._symbol.simple_bind(self._ctx, grad_req="null", **shapes)
+        ex = self._symbol.simple_bind(self._ctx, grad_req="null",
+                                      shared_exec=shared_exec, **shapes)
         for name, arr in self._params.items():
+            if shared_exec is not None and \
+                    _shares_buffer(name, ex, shared_exec):
+                continue   # same device buffer — already holds the weight
             if name in ex.arg_dict:
                 ex.arg_dict[name][:] = arr
             elif name in ex.aux_dict:
@@ -147,8 +161,16 @@ class Predictor:
             self.set_input(k, v)
         self._exec.forward(is_train=False)
 
+    def _check_output_index(self, index):
+        n = self.num_outputs
+        if not 0 <= index < n:
+            raise MXNetError("output index %d out of range for %d "
+                             "output%s" % (index, n, "" if n == 1 else "s"))
+
     def get_output_shape(self, index=0):
         """MXPredGetOutputShape."""
+        index = int(index)
+        self._check_output_index(index)
         if self._exec.outputs:
             return tuple(self._exec.outputs[index].shape)
         return tuple(self._symbol.infer_shape(**self._all_shapes())[1][index])
@@ -161,6 +183,8 @@ class Predictor:
 
     def get_output(self, index=0):
         """MXPredGetOutput: returns a host numpy array."""
+        index = int(index)
+        self._check_output_index(index)
         if not self._exec.outputs:
             raise MXNetError("call forward() before get_output()")
         return self._exec.outputs[index].asnumpy()
@@ -169,11 +193,49 @@ class Predictor:
     def num_outputs(self):
         return len(self._symbol.list_outputs())
 
+    def _check_input_names(self, input_shapes):
+        """A mistyped key must fail HERE with the valid names, not as a
+        cryptic shape-inference error out of the rebind (the reference
+        c_predict_api rejects unknown input keys the same way)."""
+        unknown = sorted(set(input_shapes) - self._input_names())
+        if unknown:
+            raise MXNetError("unknown input name%s %s; valid inputs are %s"
+                             % ("" if len(unknown) == 1 else "s",
+                                ", ".join(map(repr, unknown)),
+                                sorted(self._input_names())))
+
     def reshape(self, input_shapes):
         """MXPredReshape: rebind for new input shapes sharing the loaded
-        parameters (no reload, no recopy of weights)."""
+        parameters (no reload, no recopy of weights — the old executor's
+        parameter device buffers carry over via ``shared_exec``, since
+        an input reshape never changes a weight shape)."""
+        self._check_input_names(input_shapes)
         self._input_shapes.update(input_shapes)
-        self._bind()
+        self._bind(shared_exec=self._exec)
+
+    def sibling(self, input_shapes):
+        """A NEW independent predictor over the same symbol and loaded
+        parameters, rebound for ``input_shapes`` — the reference's
+        shared-buffer bucketing rebind (CachedOp keeps one executable
+        per shape signature; executors over one symbol share the
+        parameter device buffers via ``shared_exec``, so N bucket
+        predictors cost one copy of the weights). This handle keeps its
+        shapes; the serving engine binds one sibling per batch bucket."""
+        if self._exec is None:
+            raise MXNetError("sibling() on a closed Predictor: no bound "
+                             "executor to share weights with")
+        self._check_input_names(input_shapes)
+        new = Predictor.__new__(Predictor)
+        new._ctx = self._ctx
+        new._symbol = self._symbol
+        new._params = self._params          # shared weights
+        shapes = dict(self._input_shapes)
+        shapes.update({k: tuple(int(d) for d in s)
+                       for k, s in input_shapes.items()})
+        new._input_shapes = shapes
+        new._exec = None
+        new._bind(shared_exec=self._exec)
+        return new
 
     def close(self):
         """MXPredFree."""
@@ -234,14 +296,5 @@ def _c_reshape(pred, input_keys, input_shapes):
     """MXPredReshape: a NEW independent predictor sharing the loaded
     parameter arrays (no reload/recopy); the original handle keeps its
     shapes — reference c_predict_api.cc semantics."""
-    new = Predictor.__new__(Predictor)
-    new._ctx = pred._ctx
-    new._symbol = pred._symbol
-    new._params = pred._params          # shared weights, reference-style
-    shapes = dict(pred._input_shapes)
-    shapes.update({k: tuple(int(d) for d in s)
-                   for k, s in zip(input_keys, input_shapes)})
-    new._input_shapes = shapes
-    new._exec = None
-    new._bind()
-    return new
+    return pred.sibling({k: tuple(int(d) for d in s)
+                         for k, s in zip(input_keys, input_shapes)})

@@ -1,0 +1,28 @@
+"""Inference serving subsystem: dynamic micro-batching over bucketed
+shapes, backpressure, and an HTTP front end.
+
+The first subsystem on the inference side of the stack — built on the
+substrate of the last three PRs (elastic supervision, the telemetry
+registry, compile accounting) and the reference's CachedOp lesson: one
+XLA executable per shape signature, so serving batches are padded into
+a bounded set of batch-size buckets, all warm-compiled at startup.
+
+Layers (each its own module, composable without the ones above it):
+
+- `batching` — pure bucketing math (ladder, pick, pad, split);
+- `engine` — :class:`InferenceEngine`: replica pool, bounded queue,
+  dynamic micro-batching, deadlines, load shedding
+  (:class:`RequestRejected`), drain/shutdown, worker crash recovery;
+- `server` — stdlib ``ThreadingHTTPServer`` front end: ``/predict``,
+  ``/healthz``, ``/metrics`` (Prometheus text).
+
+Design note: docs/architecture/serving.md. Env knobs: docs/env_var.md
+(``MXNET_SERVING_*``).
+"""
+from .batching import bucket_sizes, pick_bucket, pad_rows, split_rows
+from .engine import EngineConfig, InferenceEngine, RequestRejected
+from .server import ServingHTTPServer, serve
+
+__all__ = ["bucket_sizes", "pick_bucket", "pad_rows", "split_rows",
+           "EngineConfig", "InferenceEngine", "RequestRejected",
+           "ServingHTTPServer", "serve"]

@@ -1,0 +1,80 @@
+"""Batch-size bucketing for the serving engine.
+
+One XLA executable exists per input-shape signature (the reference's
+CachedOp lesson, `predict.py` docstring), so a serving path that bound
+an executor for every distinct request count would compile without
+bound. Instead the engine pads every micro-batch up to one of a small
+fixed set of **batch-size buckets** — powers of two up to
+``max_batch_size`` — which bounds the signature set to
+``log2(max_batch) + 1`` entries, all of which are warm-compiled at
+startup. The padding waste is bounded too: a batch of n pads to less
+than 2n rows, so at most half the compute of a worst-case batch is
+padding (and measured batches cluster at the buckets under load, where
+waste goes to zero).
+
+Pure functions over numpy arrays; no engine state, no jax — unit-testable
+in isolation (`tests/test_serving.py`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["bucket_sizes", "pick_bucket", "pad_rows", "split_rows"]
+
+
+def bucket_sizes(max_batch):
+    """The bucket ladder for ``max_batch``: powers of two up to it, plus
+    ``max_batch`` itself when it is not a power of two (the top bucket
+    must admit a full batch).
+
+    >>> bucket_sizes(8)
+    [1, 2, 4, 8]
+    >>> bucket_sizes(6)
+    [1, 2, 4, 6]
+    """
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1, got %d" % max_batch)
+    sizes = []
+    b = 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return sizes
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket admitting ``n`` rows (buckets ascending)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError("batch of %d rows exceeds the top bucket %d"
+                     % (n, buckets[-1]))
+
+
+def pad_rows(arr, bucket):
+    """Pad ``arr`` (leading axis = rows) to ``bucket`` rows by repeating
+    the last row. Repetition, not zeros: the pad rows flow through the
+    same program as real data, and repeating a REAL row keeps them
+    numerically tame for models where a zero input is out-of-range
+    (BatchNorm stats are frozen at inference, so pad rows never leak
+    into real outputs either way). No copy when already full."""
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    if n > bucket:
+        raise ValueError("batch of %d rows > bucket %d" % (n, bucket))
+    pad = np.repeat(arr[-1:], bucket - n, axis=0)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def split_rows(arr, counts):
+    """Split ``arr`` back into per-request row groups; trailing pad rows
+    (``sum(counts) < len(arr)``) are dropped."""
+    out = []
+    offset = 0
+    for n in counts:
+        out.append(arr[offset:offset + n])
+        offset += n
+    return out

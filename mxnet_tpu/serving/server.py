@@ -1,0 +1,254 @@
+"""Stdlib HTTP front end for the serving engine.
+
+``ThreadingHTTPServer`` (one thread per connection — the engine's
+bounded queue, not the socket layer, is the concurrency limiter)
+exposing:
+
+- ``POST /predict`` — JSON ``{"inputs": {name: nested list},
+  "deadline_ms": optional}`` -> ``{"outputs": [...], "shapes": [...]}``.
+  Engine rejections map onto distinct status codes so clients and load
+  balancers can tell backpressure from failure: 429 (shed — retry with
+  backoff), 504 (deadline expired), 503 (draining/closed), 400 (bad
+  request), 500 (compute error).
+- ``GET /healthz`` — engine liveness: 200 with the `stats()` dict while
+  accepting and at least one replica worker is alive, 503 otherwise
+  (a draining engine fails its health check first, so a balancer stops
+  routing to it before shutdown — the graceful-removal dance).
+- ``GET /metrics`` — the whole telemetry registry as Prometheus text
+  (`telemetry.dumps()`): serving counters/histograms, compile
+  accounting, everything the process recorded.
+- ``POST /shutdown`` — only when constructed with
+  ``allow_shutdown=True`` (tests / supervised deployments): drains the
+  engine and stops the server.
+
+CLI (used by the launched serving test)::
+
+    python -m mxnet_tpu.serving.server --symbol net.json \
+        --params net.params --input data:20 --port 8000
+
+prints one ``SERVING {json}`` line with the bound address once warm.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError
+from .engine import EngineConfig, InferenceEngine, RequestRejected
+
+__all__ = ["serve", "ServingHTTPServer", "main"]
+
+logger = logging.getLogger("mxnet_tpu.serving")
+
+#: request-body cap: a predict body bigger than this is a client error,
+#: not a reason to let one connection balloon the process
+MAX_BODY_BYTES = 64 << 20
+
+_REJECT_HTTP = {"shed": 429, "expired": 504, "closed": 503}
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+    def log_message(self, fmt, *args):   # stderr spam -> debug log
+        logger.debug("http: " + fmt, *args)
+
+    def _send_json(self, code, doc):
+        body = json.dumps(doc).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code, text, content_type="text/plain"):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes -----------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/healthz":
+            st = self.server.engine.stats()
+            healthy = (not st["closed"] and not st["draining"]
+                       and st["workers_alive"] > 0)
+            st["status"] = "ok" if healthy else "unhealthy"
+            self._send_json(200 if healthy else 503, st)
+        elif self.path == "/metrics":
+            self._send_text(200, telemetry.dumps(),
+                            content_type=PROM_CONTENT_TYPE)
+        else:
+            self._send_json(404, {"error": "no route %r" % self.path})
+
+    def do_POST(self):
+        if self.path == "/predict":
+            self._predict()
+        elif self.path == "/shutdown" and self.server.allow_shutdown:
+            self._send_json(200, {"status": "shutting down"})
+            # stop() joins the serve thread; must run OFF a handler
+            # thread or serve_forever deadlocks waiting on this request
+            threading.Thread(target=self.server.stop,
+                             daemon=True).start()
+        else:
+            self._send_json(404, {"error": "no route %r" % self.path})
+
+    def _predict(self):
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length <= 0:
+            return self._send_json(400, {"error": "a JSON body with "
+                                                  "Content-Length is "
+                                                  "required"})
+        if length > MAX_BODY_BYTES:
+            return self._send_json(413, {"error": "body of %d bytes "
+                                         "exceeds the %d byte cap"
+                                         % (length, MAX_BODY_BYTES)})
+        try:
+            doc = json.loads(self.rfile.read(length).decode("utf-8"))
+            inputs = doc["inputs"]
+            deadline_ms = doc.get("deadline_ms")
+            arrays = {str(k): np.asarray(v) for k, v in inputs.items()}
+        except (ValueError, KeyError, TypeError) as exc:
+            return self._send_json(400, {"error": "bad request body: %s"
+                                         % exc})
+        try:
+            outs = self.server.engine.predict(arrays,
+                                              deadline_ms=deadline_ms)
+        except RequestRejected as exc:
+            return self._send_json(
+                _REJECT_HTTP.get(exc.status, 503),
+                {"error": str(exc), "status": exc.status})
+        except MXNetError as exc:   # validation: client's fault
+            return self._send_json(400, {"error": str(exc)})
+        except Exception as exc:    # compute/engine failure: ours
+            logger.exception("predict failed")
+            return self._send_json(500, {"error": repr(exc),
+                                         "status": "error"})
+        self._send_json(200, {
+            "outputs": [o.tolist() for o in outs],
+            "shapes": [list(o.shape) for o in outs],
+        })
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to one engine; `serve` wires it up."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, engine, allow_shutdown=False):
+        super().__init__(addr, _Handler)
+        self.engine = engine
+        self.allow_shutdown = allow_shutdown
+        self._thread = None
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="mxnet_tpu-serving-http")
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Drain the engine, then stop accepting connections."""
+        self.engine.shutdown(drain=drain)
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+def serve(engine, host="127.0.0.1", port=0, allow_shutdown=False):
+    """Start serving ``engine`` over HTTP on a daemon thread; returns
+    the :class:`ServingHTTPServer` (``.port`` for ``port=0``)."""
+    return ServingHTTPServer((host, port), engine,
+                             allow_shutdown=allow_shutdown).start()
+
+
+def _parse_input_spec(specs):
+    """``name:2,3`` per-example shape args -> {"name": (2, 3)}; a bare
+    ``name:`` is a scalar-feature input of shape ()."""
+    shapes = {}
+    for spec in specs:
+        name, _, dims = spec.partition(":")
+        if not name:
+            raise SystemExit("bad --input %r (want name:d1,d2,...)" % spec)
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d != "")
+    return shapes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Serve a (symbol JSON, params) model over HTTP with "
+                    "dynamic batching")
+    ap.add_argument("--symbol", required=True,
+                    help="symbol JSON file (Symbol.save / export)")
+    ap.add_argument("--params", required=True, help=".params file")
+    ap.add_argument("--input", required=True, action="append",
+                    help="per-example input shape, name:d1,d2,... "
+                         "(repeatable; NO batch axis)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="0 picks a free port (printed on the SERVING "
+                         "line)")
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--queue-depth", type=int, default=None)
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--allow-shutdown", action="store_true",
+                    help="expose POST /shutdown (tests, supervised "
+                         "deployments)")
+    args = ap.parse_args(argv)
+
+    with open(args.symbol, "r", encoding="utf-8") as fh:
+        symbol_json = fh.read()
+    cfg = EngineConfig(max_batch_size=args.max_batch,
+                       max_batch_delay_ms=args.max_delay_ms,
+                       max_queue=args.queue_depth,
+                       replicas=args.replicas)
+    engine = InferenceEngine(symbol_json, args.params,
+                             input_shapes=_parse_input_spec(args.input),
+                             config=cfg)
+    server = ServingHTTPServer((args.host, args.port), engine,
+                               allow_shutdown=args.allow_shutdown)
+    print("SERVING %s" % json.dumps({
+        "host": args.host, "port": server.port,
+        "buckets": engine.buckets,
+        "warmup_compiles": engine.warmup_compiles}), flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.shutdown()
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

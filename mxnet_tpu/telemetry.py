@@ -91,18 +91,43 @@ class Counter:
 
 
 class Gauge:
-    """Point-in-time value (Prometheus gauge)."""
+    """Point-in-time value (Prometheus gauge).
 
-    __slots__ = ("name", "labels", "value")
+    Either pushed (:meth:`set`/:meth:`inc`) or pulled: bind a zero-arg
+    callable with :meth:`set_function` and every snapshot/dumps samples
+    it at scrape time — the idiom for values that already live somewhere
+    (a queue's depth, a thread pool's live count) where per-update
+    pushes would race or cost a hook on every transition."""
+
+    __slots__ = ("name", "labels", "value", "fn")
 
     def __init__(self, name, labels):
         self.name = name
         self.labels = labels
         self.value = 0.0
+        self.fn = None
 
     def set(self, value):
         with _lock:
             self.value = float(value)
+
+    def set_function(self, fn):
+        """Sample ``fn()`` at scrape time instead of a pushed value
+        (``None`` unbinds). A raising/None-returning callable degrades
+        to the last pushed value — scrapes never propagate it."""
+        with _lock:
+            self.fn = fn
+
+    def read(self):
+        fn = self.fn
+        if fn is not None:
+            try:
+                v = fn()
+                if v is not None:
+                    return float(v)
+            except Exception:
+                pass
+        return self.value
 
     def inc(self, amount=1.0):
         with _lock:
@@ -232,8 +257,9 @@ def snapshot():
                 "p50": m.quantile(0.5), "p95": m.quantile(0.95),
                 "p99": m.quantile(0.99)})
         else:
+            value = m.read() if isinstance(m, Gauge) else m.value
             entry["series"].append({"labels": dict(m.labels),
-                                    "value": m.value})
+                                    "value": value})
     return out
 
 

@@ -78,7 +78,8 @@ from collections import deque
 
 from . import telemetry
 
-__all__ = ["TrackedJit", "tracked_jit", "aot_compile", "last_retrace",
+__all__ = ["TrackedJit", "tracked_jit", "aot_compile", "compile_counts",
+           "last_retrace",
            "explain_signature_change", "ledger_set", "ledger",
            "tree_bytes", "device_memory", "live_buffers", "memory_report",
            "peak_flops_per_device", "peak_flops_total", "note_train_step",
@@ -244,6 +245,21 @@ def explain_signature_change(old, new):
             parts.append("%s: %s" % (k, _diff_desc(a, b)))
     return "; ".join(parts) or \
         "no signature change detected (new code object or closure)"
+
+
+def compile_counts():
+    """Point-in-time totals of the unlabeled compile-accounting
+    counters: ``{"compiles", "cache_hits", "retraces"}``. The serving
+    engine snapshots this around bucket warm-up to PROVE steady-state
+    serving never compiles (`serving/engine.py`); tests diff two
+    snapshots instead of scraping Prometheus text."""
+    out = {}
+    for key, name in (("compiles", "jit_compiles_total"),
+                      ("cache_hits", "jit_cache_hits_total"),
+                      ("retraces", "jit_retraces_total")):
+        m = telemetry.get_metric(name)
+        out[key] = float(m.value) if m is not None else 0.0
+    return out
 
 
 def last_retrace():

@@ -4,6 +4,7 @@ last-batch modes."""
 import gzip
 import os
 import struct
+import time
 
 import numpy as np
 import pytest
@@ -166,3 +167,67 @@ def test_hue_and_gray_augmenters():
     augs = img_mod.CreateAugmenter((3, 6, 6), hue=0.2, rand_gray=0.5)
     assert any(isinstance(a, img_mod.HueJitterAug) for a in augs)
     assert any(isinstance(a, img_mod.RandomGrayAug) for a in augs)
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter: error propagation + reset thread hygiene
+# ---------------------------------------------------------------------------
+
+class _ExplodingIter(mx.io.DataIter):
+    """Yields `good` batches, then crashes mid-epoch."""
+
+    def __init__(self, good=2, batch_size=4):
+        super().__init__()
+        self.good = good
+        self.batch_size = batch_size
+        self.provide_data = [mx.io.DataDesc("data", (batch_size, 2))]
+        self.provide_label = []
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self.good:
+            raise RuntimeError("disk died mid-epoch")
+        self._i += 1
+        return mx.io.DataBatch(
+            data=[mx.nd.zeros((self.batch_size, 2))], label=[], pad=0)
+
+
+def test_prefetch_propagates_producer_error():
+    """A crash of the wrapped iterator must surface in iter_next(), not
+    masquerade as a clean end-of-epoch (silent data truncation)."""
+    it = mx.io.PrefetchingIter(_ExplodingIter(good=2))
+    assert it.iter_next()
+    assert it.iter_next()
+    with pytest.raises(RuntimeError, match="disk died mid-epoch"):
+        it.iter_next()
+
+
+def test_prefetch_error_cleared_by_reset():
+    it = mx.io.PrefetchingIter(_ExplodingIter(good=1))
+    assert it.iter_next()
+    with pytest.raises(RuntimeError):
+        it.iter_next()
+    it.reset()
+    assert it.iter_next()          # fresh epoch serves again
+    with pytest.raises(RuntimeError):
+        it.iter_next()
+
+
+def test_prefetch_reset_joins_producer_thread():
+    """reset() while the producer is blocked on a FULL queue: the
+    stop-aware put lets it exit, the old thread is provably joined, and
+    the restarted epoch is complete and in order."""
+    X = np.arange(40, dtype=np.float32).reshape(20, 2)
+    base = mx.io.NDArrayIter(X, batch_size=2, shuffle=False)
+    it = mx.io.PrefetchingIter(base, depth=2)
+    time.sleep(0.05)               # let the producer fill the queue
+    for _ in range(3):
+        old = it._thread
+        it.reset()
+        assert not old.is_alive()  # no leaked thread feeding a dead queue
+    got = [b.data[0].asnumpy() for b in it]
+    assert len(got) == 10
+    np.testing.assert_array_equal(np.concatenate(got), X)

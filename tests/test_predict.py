@@ -1,0 +1,216 @@
+"""`mxnet_tpu/predict.py` (reference c_predict_api): create /
+partial-out / keyword forward / reshape weight-sharing / the `_c_*`
+native-boundary helpers / error paths."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import predict as P
+from mxnet_tpu.predict import Predictor
+
+IN_DIM = 10
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    return mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+
+
+def _init_params(net, batch=4):
+    """Random weights via a bound executor; returns {name: NDArray}."""
+    exe = net.simple_bind(mx.cpu(), data=(batch, IN_DIM))
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            continue
+        arr[:] = (rng.randn(*arr.shape) * 0.1).astype(np.float32)
+        params[name] = arr
+    return params
+
+
+def _np_forward(params, x):
+    h = x @ params["fc1_weight"].asnumpy().T + params["fc1_bias"].asnumpy()
+    h = np.maximum(h, 0.0)
+    return h @ params["fc2_weight"].asnumpy().T \
+        + params["fc2_bias"].asnumpy()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _mlp()
+
+
+@pytest.fixture(scope="module")
+def params(net):
+    return _init_params(net)
+
+
+def test_create_forward_get_output(net, params):
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)})
+    x = np.random.RandomState(1).rand(4, IN_DIM).astype(np.float32)
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    assert out.shape == (4, 3)
+    np.testing.assert_allclose(out, _np_forward(params, x), atol=1e-5)
+    assert pred.num_outputs == 1
+    assert pred.get_output_shape(0) == (4, 3)
+
+
+def test_create_from_params_file_and_bytes(net, params, tmp_path):
+    path = str(tmp_path / "net.params")
+    # reference .params container carries arg:/aux: prefixed names
+    mx.nd.save(path, {"arg:%s" % k: v for k, v in params.items()})
+    x = np.random.RandomState(2).rand(2, IN_DIM).astype(np.float32)
+    want = _np_forward(params, x)
+
+    for blob in (path, open(path, "rb").read()):
+        pred = Predictor(net.tojson(), blob,
+                         input_shapes={"data": (2, IN_DIM)})
+        pred.forward(data=x)
+        np.testing.assert_allclose(pred.get_output(0), want, atol=1e-5)
+
+
+def test_partial_out(net, params):
+    # MXPredCreatePartialOut: bind an internal layer as the output
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)},
+                     output_names=["fc1"])
+    x = np.random.RandomState(3).rand(4, IN_DIM).astype(np.float32)
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    assert out.shape == (4, 8)
+    w, b = params["fc1_weight"].asnumpy(), params["fc1_bias"].asnumpy()
+    np.testing.assert_allclose(out, x @ w.T + b, atol=1e-5)
+
+
+def test_set_input_checks(net, params):
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)})
+    with pytest.raises(mx.MXNetError, match="no input named"):
+        pred.set_input("bogus", np.zeros((4, IN_DIM), np.float32))
+    # a weight is NOT a settable input (reference rejects non-input keys)
+    with pytest.raises(mx.MXNetError, match="no input named"):
+        pred.set_input("fc1_weight", params["fc1_weight"].asnumpy())
+    with pytest.raises(mx.MXNetError, match="use reshape"):
+        pred.set_input("data", np.zeros((5, IN_DIM), np.float32))
+
+
+def test_reshape_shares_weights(net, params):
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)})
+    held = pred._params
+    pred.reshape({"data": (7, IN_DIM)})
+    assert pred._params is held          # no reload of the blob
+    x = np.random.RandomState(4).rand(7, IN_DIM).astype(np.float32)
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    assert out.shape == (7, 3)
+    np.testing.assert_allclose(out, _np_forward(params, x), atol=1e-5)
+
+
+def test_reshape_rejects_unknown_names(net, params):
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)})
+    with pytest.raises(mx.MXNetError, match=r"unknown input name.*'datum'"
+                                            r".*valid inputs.*data"):
+        pred.reshape({"datum": (4, IN_DIM)})
+    # the typo did NOT corrupt the bound shapes
+    pred.forward(data=np.zeros((4, IN_DIM), np.float32))
+    assert pred.get_output(0).shape == (4, 3)
+
+
+def test_sibling_shares_param_buffers(net, params):
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)})
+    sib = pred.sibling({"data": (2, IN_DIM)})
+    assert sib._params is pred._params
+    # the weight DEVICE buffers are the same NDArrays (shared_exec), so
+    # N bucket-bound predictors cost one copy of the model
+    for name in params:
+        assert sib._exec.arg_dict[name] is pred._exec.arg_dict[name]
+    # the original handle keeps its shapes
+    assert pred._exec.arg_dict["data"].shape == (4, IN_DIM)
+    x = np.random.RandomState(5).rand(2, IN_DIM).astype(np.float32)
+    sib.forward(data=x)
+    np.testing.assert_allclose(sib.get_output(0), _np_forward(params, x),
+                               atol=1e-5)
+
+
+def test_output_index_bounds(net, params):
+    pred = Predictor(net.tojson(), dict(params),
+                     input_shapes={"data": (4, IN_DIM)})
+    pred.forward(data=np.zeros((4, IN_DIM), np.float32))
+    for bad in (1, -1, 99):
+        with pytest.raises(mx.MXNetError, match="out of range"):
+            pred.get_output(bad)
+        with pytest.raises(mx.MXNetError, match="out of range"):
+            pred.get_output_shape(bad)
+
+
+def test_aux_states_load(tmp_path):
+    # BatchNorm carries aux states: the aux: prefix path must populate
+    # moving_mean/moving_var, and inference must consume them
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False)
+    exe = bn.simple_bind(mx.cpu(), data=(4, 6))
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            continue
+        arr[:] = 1.0 if name.endswith("gamma") else 0.0
+        params["arg:%s" % name] = arr
+    mean = np.arange(6, dtype=np.float32)
+    for name, arr in exe.aux_dict.items():
+        arr[:] = mean if name.endswith("mean") else 1.0
+        params["aux:%s" % name] = arr
+    pred = Predictor(bn.tojson(), params, input_shapes={"data": (4, 6)})
+    x = np.tile(mean, (4, 1))
+    pred.forward(data=x)
+    # (x - moving_mean) / sqrt(var + eps): exactly zero at x == mean
+    np.testing.assert_allclose(pred.get_output(0), np.zeros((4, 6)),
+                               atol=1e-4)
+
+
+def test_c_boundary_helpers(net, params, tmp_path):
+    path = str(tmp_path / "net.params")
+    mx.nd.save(path, {"arg:%s" % k: v for k, v in params.items()})
+    blob = open(path, "rb").read()
+    pred = P._c_create(net.tojson(), blob, 1, 0, ["data"],
+                       [(4, IN_DIM)], [])
+    x = np.random.RandomState(6).rand(4, IN_DIM).astype(np.float32)
+    P._c_set_input(pred, "data", memoryview(x.tobytes()), x.size)
+    pred.forward()
+    assert P._c_output_shape(pred, 0) == (4, 3)
+    out = np.frombuffer(P._c_get_output_bytes(pred, 0),
+                        dtype=np.float32).reshape(4, 3)
+    np.testing.assert_allclose(out, _np_forward(params, x), atol=1e-5)
+
+    with pytest.raises(mx.MXNetError, match="no input named"):
+        P._c_set_input(pred, "nope", memoryview(x.tobytes()), x.size)
+    with pytest.raises(mx.MXNetError, match="size"):
+        P._c_set_input(pred, "data", memoryview(x.tobytes()), x.size - 1)
+
+    # _c_reshape: NEW handle, shared weights, original keeps its shapes
+    new = P._c_reshape(pred, ["data"], [(2, IN_DIM)])
+    assert new is not pred and new._params is pred._params
+    assert pred._exec.arg_dict["data"].shape == (4, IN_DIM)
+    x2 = x[:2]
+    new.forward(data=x2)
+    np.testing.assert_allclose(new.get_output(0),
+                               _np_forward(params, x2), atol=1e-5)
+
+
+def test_context_manager_close(net, params):
+    with Predictor(net.tojson(), dict(params),
+                   input_shapes={"data": (2, IN_DIM)}) as pred:
+        pred.forward(data=np.zeros((2, IN_DIM), np.float32))
+        assert pred.get_output(0).shape == (2, 3)
+    assert pred._exec is None   # MXPredFree
+    with pytest.raises(mx.MXNetError, match="closed Predictor"):
+        pred.sibling({"data": (2, IN_DIM)})

@@ -263,3 +263,26 @@ def test_bench_all_emits_json_records(tmp_path):
     assert rec["value"] and rec["value"] > 0
     saved = json.loads(out.read_text())
     assert saved[0]["metric"] == rec["metric"]
+
+
+def test_serve_bench_closed_loop(tmp_path):
+    """serve_bench: closed loop against the demo engine emits the
+    BENCH-style metric lines and they parse through bench_gate."""
+    import json
+    out = str(tmp_path / "serve.jsonl")
+    r = _run("serve_bench.py", "--mode", "closed", "--clients", "2",
+             "--requests", "3", "--sizes", "1,2", "--out", out)
+    assert r.returncode == 0, r.stderr
+    sys.path.insert(0, TOOLS)
+    import bench_gate
+    recs = bench_gate.parse_lines(open(out).read().splitlines())
+    metrics = {rec["metric"]: rec for rec in recs}
+    for name in ("serving_warmup_compiles", "serving_closed_rps",
+                 "serving_closed_rows_per_sec", "serving_closed_p50_ms",
+                 "serving_closed_p95_ms", "serving_closed_p99_ms",
+                 "serving_cold_compiles"):
+        assert name in metrics, (name, sorted(metrics))
+    assert metrics["serving_closed_rps"]["value"] > 0
+    assert metrics["serving_cold_compiles"]["value"] == 0
+    # 2 clients x 3 requests, none rejected in an unloaded engine
+    assert "serving_closed_shed_total" not in metrics

@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""Serving load generator: closed- and open-loop benchmarks against the
+dynamic-batching inference engine (`mxnet_tpu/serving/`).
+
+Two load models, because they answer different questions:
+
+- **closed loop** (``--mode closed``): N client threads, each holding at
+  most one request in flight (submit, block on the result, repeat).
+  Measures sustainable throughput under coordinated omission-free
+  latency — the classic "how fast can K users go" number.
+- **open loop** (``--mode open``): requests fire at a fixed arrival rate
+  regardless of completions (``--qps``), the way real traffic arrives.
+  Latency percentiles under an open load expose queueing delay the
+  closed loop hides; shed counts expose where backpressure engages.
+
+Reports throughput + p50/p95/p99 and writes BENCH-style JSON metric
+lines ({"metric", "value", "unit", ...}) — the same shape bench.py
+emits, so ``python bench.py --serve`` embeds these records and
+``tools/bench_gate.py`` can gate them (``--metric
+serving_closed_rps``).
+
+Default target is a built-in small MLP engine (CPU-friendly, no files);
+point it at an exported model with ``--symbol/--params/--input`` or at
+a RUNNING server with ``--url http://host:port`` (closed loop only —
+open-loop HTTP would measure the client's connection churn, not the
+engine).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_demo_engine(config=None, ctx=None):
+    """A small MLP engine over random weights: enough compute to batch
+    meaningfully, small enough to warm-compile in seconds on CPU.
+    Returns ``(engine, input_name, example_shape)``."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import InferenceEngine
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    exe = net.simple_bind(mx.cpu(), data=(2, 32))
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = (rng.randn(*arr.shape) * 0.1).astype(np.float32)
+            params[name] = arr
+    engine = InferenceEngine(net.tojson(), params, {"data": (32,)},
+                             ctx=ctx, config=config)
+    return engine, "data", (32,)
+
+
+def build_file_engine(symbol_path, params_path, input_specs, config=None):
+    from mxnet_tpu.serving import InferenceEngine
+    from mxnet_tpu.serving.server import _parse_input_spec
+    with open(symbol_path, "r", encoding="utf-8") as fh:
+        symbol_json = fh.read()
+    shapes = _parse_input_spec(input_specs)
+    engine = InferenceEngine(symbol_json, params_path, shapes,
+                             config=config)
+    name, shape = next(iter(shapes.items()))
+    return engine, name, shape
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) \
+        * (pos - lo)
+
+
+class _Tally:
+    """Thread-safe latency/status accumulator."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies = []      # seconds, completed requests only
+        self.statuses = {}       # status -> count
+        self.rows_done = 0
+
+    def ok(self, latency, rows):
+        with self.lock:
+            self.latencies.append(latency)
+            self.statuses["ok"] = self.statuses.get("ok", 0) + 1
+            self.rows_done += rows
+
+    def fail(self, status):
+        with self.lock:
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+
+    def records(self, mode, elapsed):
+        lats = sorted(self.latencies)
+        done = len(lats)
+        recs = [
+            {"metric": "serving_%s_rps" % mode,
+             "value": round(done / elapsed, 2) if elapsed else 0.0,
+             "unit": "req/s", "mode": mode},
+            {"metric": "serving_%s_rows_per_sec" % mode,
+             "value": round(self.rows_done / elapsed, 2) if elapsed
+             else 0.0,
+             "unit": "rows/s", "mode": mode},
+        ]
+        for q, label in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            recs.append({"metric": "serving_%s_%s_ms" % (mode, label),
+                         "value": round(_percentile(lats, q) * 1e3, 3),
+                         "unit": "ms", "mode": mode})
+        for status, count in sorted(self.statuses.items()):
+            if status != "ok":
+                recs.append({"metric": "serving_%s_%s_total"
+                             % (mode, status),
+                             "value": count, "unit": "requests",
+                             "mode": mode})
+        return recs
+
+
+def _status_of(exc):
+    return getattr(exc, "status", "error")
+
+
+def run_closed(submit_and_wait, clients, requests_per_client, sizes,
+               make_input):
+    """Closed loop: ``clients`` threads each issue
+    ``requests_per_client`` blocking requests of rotating ``sizes``.
+    ``submit_and_wait(inputs) -> rows`` raises on rejection/error."""
+    tally = _Tally()
+
+    def client(cid):
+        rng = np.random.RandomState(cid)
+        for i in range(requests_per_client):
+            n = sizes[(cid + i) % len(sizes)]
+            inputs = make_input(n, rng)
+            t0 = time.monotonic()
+            try:
+                rows = submit_and_wait(inputs)
+            except Exception as exc:   # noqa: BLE001 - tallied
+                tally.fail(_status_of(exc))
+                continue
+            tally.ok(time.monotonic() - t0, rows)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return tally, time.monotonic() - t0
+
+
+def run_open(engine, qps, seconds, sizes, make_input):
+    """Open loop: fire ``engine.submit`` at a fixed ``qps`` for
+    ``seconds`` without waiting; latencies land via future callbacks
+    (arrival-time anchored, so queueing delay is IN the number)."""
+    from mxnet_tpu.serving import RequestRejected
+
+    if qps <= 0:
+        raise ValueError("open-loop qps must be > 0, got %g" % qps)
+    tally = _Tally()
+    rng = np.random.RandomState(0)
+    interval = 1.0 / qps
+    futures = []
+    t0 = time.monotonic()
+    i = 0
+    while time.monotonic() - t0 < seconds:
+        target = t0 + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        n = sizes[i % len(sizes)]
+        sent = time.monotonic()
+        try:
+            fut = engine.submit(make_input(n, rng))
+        except RequestRejected as exc:
+            tally.fail(exc.status)
+        else:
+            def _done(f, sent=sent, n=n):
+                exc = f.exception()
+                if exc is None:
+                    tally.ok(time.monotonic() - sent, n)
+                else:
+                    tally.fail(_status_of(exc))
+            fut.add_done_callback(_done)
+            futures.append(fut)
+        i += 1
+    for fut in futures:
+        try:
+            fut.result(timeout=30)
+        except Exception:   # noqa: BLE001 - already tallied by callback
+            pass
+    return tally, time.monotonic() - t0
+
+
+def http_submit_and_wait(host, port, input_name, timeout=30):
+    """Closed-loop submitter over HTTP (one connection per client
+    thread, stdlib only)."""
+    import http.client
+    local = threading.local()
+
+    def call(inputs):
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+            local.conn = conn
+        body = json.dumps({"inputs": {k: v.tolist()
+                                      for k, v in inputs.items()}})
+        try:
+            conn.request("POST", "/predict", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+        except Exception:
+            local.conn = None   # poisoned connection: rebuild next call
+            raise
+        if resp.status != 200:
+            err = RuntimeError(doc.get("error", "HTTP %d" % resp.status))
+            err.status = doc.get("status", "error")
+            raise err
+        return len(inputs[input_name])
+
+    return call
+
+
+def bench_records(clients=8, requests_per_client=25, qps=150.0,
+                  seconds=2.0, sizes=(1, 2, 3, 5), config=None,
+                  mode="both", engine_factory=None):
+    """The ONE in-process bench path (bench.py --serve and the CLI's
+    non-URL branch both land here): closed and/or open loop against
+    ``engine_factory()`` (default: the demo engine); returns the metric
+    records (engine is shut down)."""
+    make = engine_factory or (lambda: build_demo_engine(config=config))
+    engine, name, shape = make()
+    records = [{"metric": "serving_warmup_compiles",
+                "value": engine.warmup_compiles, "unit": "compiles",
+                "buckets": engine.buckets}]
+
+    def make_input(n, rng):
+        return {name: rng.rand(n, *shape).astype(np.float32)}
+
+    def submit_and_wait(inputs):
+        engine.predict(inputs, timeout=30)
+        return len(inputs[name])
+
+    try:
+        if mode in ("closed", "both"):
+            tally, elapsed = run_closed(submit_and_wait, clients,
+                                        requests_per_client, list(sizes),
+                                        make_input)
+            records.extend(tally.records("closed", elapsed))
+        if mode in ("open", "both"):
+            tally, elapsed = run_open(engine, qps, seconds, list(sizes),
+                                      make_input)
+            records.extend(tally.records("open", elapsed))
+        records.append({"metric": "serving_cold_compiles",
+                        "value": engine.cold_compiles(),
+                        "unit": "compiles"})
+    finally:
+        engine.shutdown()
+    return records
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=["closed", "open", "both"],
+                    default="both")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="closed-loop client threads")
+    ap.add_argument("--requests", type=int, default=25,
+                    help="closed-loop requests per client")
+    ap.add_argument("--qps", type=float, default=150.0,
+                    help="open-loop arrival rate")
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="open-loop duration")
+    ap.add_argument("--sizes", default="1,2,3,5",
+                    help="rotating request row counts")
+    ap.add_argument("--symbol", default=None)
+    ap.add_argument("--params", default=None)
+    ap.add_argument("--input", action="append", default=None,
+                    help="name:d1,d2,... per-example shape (with "
+                         "--symbol)")
+    ap.add_argument("--url", default=None,
+                    help="benchmark a RUNNING server (host:port or "
+                         "http://host:port; closed loop only)")
+    ap.add_argument("--out", default=None,
+                    help="also append the JSON metric lines to a file")
+    args = ap.parse_args(argv)
+    if args.mode in ("open", "both") and args.qps <= 0 and not args.url:
+        ap.error("--qps must be > 0 for open-loop mode")
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+
+    records = []
+    if args.url:
+        target = args.url.split("//")[-1].rstrip("/")
+        host, _, port = target.partition(":")
+        call = http_submit_and_wait(host, int(port or 80), "data")
+        input_name, shape = "data", (32,)
+        if args.input:
+            from mxnet_tpu.serving.server import _parse_input_spec
+            input_name, shape = next(iter(
+                _parse_input_spec(args.input).items()))
+            call = http_submit_and_wait(host, int(port or 80), input_name)
+
+        def make_input(n, rng):
+            return {input_name: rng.rand(n, *shape).astype(np.float32)}
+
+        tally, elapsed = run_closed(call, args.clients, args.requests,
+                                    sizes, make_input)
+        records.extend(tally.records("closed", elapsed))
+    else:
+        factory = None
+        if args.symbol:
+            factory = lambda: build_file_engine(  # noqa: E731
+                args.symbol, args.params, args.input)
+        records = bench_records(
+            clients=args.clients, requests_per_client=args.requests,
+            qps=args.qps, seconds=args.seconds, sizes=sizes,
+            mode=args.mode, engine_factory=factory)
+
+    for rec in records:
+        print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
