@@ -28,6 +28,7 @@ def device_of(val):
             if len(devs) > 1:
                 return val.sharding
             return next(iter(devs))
+        # mxanalyze: allow(swallowed-exception): tracers/deleted arrays have no devices(); None is the documented answer
         except Exception:
             return None
     return None
@@ -65,6 +66,7 @@ try:  # pragma: no cover
     import jax as _jax
 
     _jax.config.update("jax_enable_x64", True)
+# mxanalyze: allow(swallowed-exception): optional import-time config — a jax too old for the flag still works in float32
 except Exception:
     pass
 
@@ -75,6 +77,7 @@ try:  # pragma: no cover - jax always present in this environment
     bfloat16 = _jnp.bfloat16
     _DTYPE_NP_TO_MX[np.dtype(bfloat16)] = 12
     _DTYPE_MX_TO_NP[12] = np.dtype(bfloat16)
+# mxanalyze: allow(swallowed-exception): bfloat16 = None is the documented degradation when jax/ml_dtypes is absent
 except Exception:  # pragma: no cover
     bfloat16 = None
 

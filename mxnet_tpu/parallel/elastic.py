@@ -25,6 +25,14 @@ sharded checkpoints (`parallel/checkpoint.py`), and bounded backoff
 Every failure path is exercised by the chaos layer (`mxnet_tpu.chaos`):
 injected coordinator timeouts, delayed heartbeats, mid-step worker death,
 interrupted checkpoint writes.
+
+Threading note (checked by ``tools/mxanalyze`` lock-discipline): this
+module holds NO locks. The watchdog thread shares only the stop Event
+(``_wd_stop``) with the step loop and otherwise exits the process via
+``os._exit`` — by design it must make progress while the main thread is
+wedged in a collective, so it must never wait on a lock the step loop
+could be holding. Keep it that way: anything the watchdog reads must be
+lock-free.
 """
 from __future__ import annotations
 

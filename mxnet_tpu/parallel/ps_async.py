@@ -251,12 +251,14 @@ class AsyncPSServer:
         With no optimizer set, pushes overwrite (assignment) like the
         reference's default merge for a single worker."""
         if self.updater is None:
+            # mxanalyze: allow(lock-discipline): guarded by the per-key lock self.locks[key], held by the push/pull caller
             self.store[key] = grad.astype(self.store[key].dtype)
             return
         from ..ndarray import array as nd_array
         w = nd_array(self.store[key])
         g = nd_array(grad)
         self.updater(key, g, w)
+        # mxanalyze: allow(lock-discipline): guarded by the per-key lock self.locks[key], held by the push/pull caller
         self.store[key] = w.asnumpy()
 
 

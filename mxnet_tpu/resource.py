@@ -90,6 +90,7 @@ class Resource:
         if self._key is None:
             # independent stream per (ctx, slot): fold the slot id into the
             # root key so streams never collide with eager sampling
+            # mxanalyze: allow(lock-discipline): only called by next_key/parallel_keys, which already hold self._lock
             self._key = jax.random.fold_in(
                 _random.get_key(self.ctx),
                 (hash((self.ctx.device_typeid, self.ctx.device_id,

@@ -35,6 +35,14 @@ Telemetry (all in the process-wide registry, scraped by
 
 Defaults come from ``MXNET_SERVING_*`` env vars (docs/env_var.md) via
 :class:`EngineConfig`.
+
+Lock order (checked by ``tools/mxanalyze`` lock-discipline): the engine
+has ONE lock, ``self._cond`` — every read-modify-write of the shared
+lifecycle state (``_pending`` / ``_draining`` / ``_closed``) happens
+under it, and nothing else is ever acquired while it is held (queue
+operations use the queues' internal locks only). Telemetry calls may
+take the registry lock; never call into the engine from a telemetry
+tap.
 """
 from __future__ import annotations
 
@@ -240,6 +248,9 @@ class InferenceEngine:
         self._pending = 0          # submitted, not yet resolved
         self._draining = False
         self._closed = False
+        self._shutdown_started = False
+        self._shutdown_done = threading.Event()
+        self._shutdown_owner = None
         self._batcher = None
         self.warmup_compiles = 0
         self._post_warmup_compiles = None
@@ -419,44 +430,79 @@ class InferenceEngine:
         """Stop the engine. ``drain=True`` (default) serves out whatever
         is queued first; ``drain=False`` fails queued requests with
         ``status="closed"``. Idempotent; joins every engine thread."""
-        if self._closed:
-            return
-        if drain:
-            self.drain(timeout)
+        # the idempotency check-and-set happens under the lifecycle lock:
+        # two concurrent GRACEFUL shutdown() calls (server signal handler
+        # + atexit) must not both run the drain sequence — the loser
+        # BLOCKS until the winner finished, so "returned" keeps meaning
+        # "every engine thread is joined". A concurrent FORCED call
+        # (drain=False / close()) is the escape hatch for a wedged drain
+        # and must NOT wait: it falls through and runs the bounded force
+        # sequence (flush, STOPs, timed joins) so the process can still
+        # exit; every step is safe to run concurrently with the draining
+        # winner. _closed itself flips only AFTER a graceful drain —
+        # workers dying mid-drain must keep respawning or the drain
+        # would wedge.
         with self._cond:
+            already = self._shutdown_started
+            self._shutdown_started = True
             self._draining = True
-            self._closed = True
-        # submit() checks the flags under the same lock, so nothing can
-        # enqueue after this point — the flush below is complete
-        if not drain:
-            self._flush_queue()
-        while True:
-            try:
-                self._queue.put(_STOP, timeout=1)
-                break
-            except _queue.Full:
-                # a drain that timed out over a wedged pipeline leaves
-                # the queue full; those requests can never be served
-                # now — fail them "closed", which also frees a slot
-                self._flush_queue()
-        self._batcher.join(timeout=30)
+            if not already:
+                self._shutdown_owner = threading.current_thread()
+        if already:
+            if threading.current_thread() is self._shutdown_owner:
+                # re-entrant call from WITHIN the shutdown sequence (a
+                # client Future done-callback runs inline in _resolve):
+                # waiting would deadlock on our own not-yet-set Event
+                return
+            if drain:
+                # honor the caller's bound: timeout=None inherits the
+                # winner's (possibly unbounded) drain, a finite timeout
+                # returns after it even if the winner is still draining
+                self._shutdown_done.wait(timeout)
+                return
+            if self._shutdown_done.is_set():
+                return   # already fully shut down: idempotent fast path
+            # else: forced caller racing an IN-PROGRESS shutdown — fall
+            # through to the bounded force sequence (the wedged-drain
+            # escape hatch)
         try:
-            # bounded like every other shutdown step: with a wedged
-            # worker (the drain=False case exists for exactly that) the
-            # work queue may never free a slot
-            self._work.put(_STOP, timeout=30)
-        except _queue.Full:
-            logger.warning("serving: work queue still full at shutdown; "
-                           "replica workers appear wedged")
-        for rep in self._replicas:
-            if rep.thread is not None:
-                rep.thread.join(timeout=30)
-        for name in ("serving_queue_depth", "serving_workers_alive",
-                     "serving_inflight_requests"):
-            g = telemetry.get_metric(name, engine=self._engine_label)
-            if g is not None:
-                g.set(g.read())
-                g.set_function(None)
+            if drain:
+                self.drain(timeout)
+            with self._cond:
+                self._closed = True
+            # submit() checks the flags under the same lock, so nothing
+            # can enqueue after this point — the flush below is complete
+            if not drain:
+                self._flush_queue()
+            while True:
+                try:
+                    self._queue.put(_STOP, timeout=1)
+                    break
+                except _queue.Full:
+                    # a drain that timed out over a wedged pipeline
+                    # leaves the queue full; those requests can never be
+                    # served now — fail them "closed", freeing a slot
+                    self._flush_queue()
+            self._batcher.join(timeout=30)
+            try:
+                # bounded like every other shutdown step: with a wedged
+                # worker (the drain=False case exists for exactly that)
+                # the work queue may never free a slot
+                self._work.put(_STOP, timeout=30)
+            except _queue.Full:
+                logger.warning("serving: work queue still full at "
+                               "shutdown; replica workers appear wedged")
+            for rep in self._replicas:
+                if rep.thread is not None:
+                    rep.thread.join(timeout=30)
+            for name in ("serving_queue_depth", "serving_workers_alive",
+                         "serving_inflight_requests"):
+                g = telemetry.get_metric(name, engine=self._engine_label)
+                if g is not None:
+                    g.set(g.read())
+                    g.set_function(None)
+        finally:
+            self._shutdown_done.set()   # never leave a waiter wedged
 
     def _flush_queue(self):
         while True:
@@ -627,6 +673,7 @@ class InferenceEngine:
                     return
                 self._run_batch(rep, item)
                 item = None
+        # mxanalyze: allow(swallowed-exception): crash isolation — _on_worker_death logs, counts, dumps the flight recorder, and respawns
         except BaseException as exc:   # noqa: BLE001 - crash isolation
             self._on_worker_death(rep, item, exc)
 

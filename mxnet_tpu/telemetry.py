@@ -32,11 +32,19 @@ cost one dict lookup + two clock reads.
 
 Everything here is stdlib-only at import time — telemetry must be
 importable before jax initializes any backend.
+
+Lock order (checked by ``tools/mxanalyze`` lock-discipline): this module
+has ONE lock, the registry ``_lock`` (reentrant). Every mutation of
+``_metrics`` / ``_kinds`` / ``_state`` / ``_taps`` happens under it;
+callers must not invoke telemetry while holding their own locks that
+they also take inside a tap callback (taps run under no telemetry lock,
+but ``counter()``/``gauge()`` calls from a tap re-enter ``_lock``).
 """
 from __future__ import annotations
 
 import atexit
 import json
+import logging
 import os
 import random
 import re
@@ -47,7 +55,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "snapshot", "dumps", "reset",
            "span", "event", "configure", "configured_dir", "flush",
            "write_snapshot", "host_id", "set_host_id", "read_events",
-           "to_chrome", "merge", "add_tap", "remove_tap"]
+           "to_chrome", "merge", "add_tap", "remove_tap", "swallowed"]
+
+_logger = logging.getLogger("mxnet_tpu.telemetry")
 
 _lock = threading.RLock()
 _metrics = {}   # (name, label_items) -> metric
@@ -125,8 +135,8 @@ class Gauge:
                 v = fn()
                 if v is not None:
                     return float(v)
-            except Exception:
-                pass
+            except Exception as exc:
+                swallowed("telemetry.gauge_read", exc)
         return self.value
 
     def inc(self, amount=1.0):
@@ -220,6 +230,23 @@ def gauge(name, help="", **labels):
 def histogram(name, help="", reservoir=2048, **labels):
     """Get-or-create a labeled bounded-reservoir histogram."""
     return _get("histogram", name, help, labels, reservoir=reservoir)
+
+
+def swallowed(site, exc=None):
+    """Account a deliberately swallowed exception: bump
+    ``errors_swallowed_total{site=}`` and debug-log it, raising nothing.
+    The one-line idiom for ``except Exception`` handlers that must not
+    propagate (exit paths, best-effort probes) — the failure still
+    leaves a countable trace instead of disappearing."""
+    try:
+        counter("errors_swallowed_total",
+                help="exceptions deliberately swallowed, by site",
+                site=site).inc()
+        if exc is not None:
+            _logger.debug("swallowed[%s]: %r", site, exc)
+    # mxanalyze: allow(swallowed-exception): the accounting sink itself must never raise
+    except Exception:
+        pass
 
 
 def get_metric(name, **labels):
@@ -321,7 +348,8 @@ def dumps():
 
 def set_host_id(hid):
     """Pin this process's host id (called by ``dist.init`` on attach)."""
-    _state["host_id"] = int(hid)
+    with _lock:   # _state is only ever mutated under the registry lock
+        _state["host_id"] = int(hid)
 
 
 def host_id():
@@ -344,8 +372,8 @@ def host_id():
         pid = getattr(getattr(jd, "global_state", None), "process_id", None)
         if pid is not None:
             return int(pid)
-    except Exception:  # pragma: no cover
-        pass
+    except Exception as exc:  # pragma: no cover
+        swallowed("telemetry.host_id", exc)
     return 0
 
 
@@ -359,6 +387,35 @@ def configure(dir=None, host=None, snapshot_interval=None):
     ``.prom`` snapshot rewrites (default ``MXNET_TELEMETRY_INTERVAL`` or
     30; 0 disables the background writer — :func:`flush`/exit still
     write one)."""
+    # slow work (makedirs — the dir may be NFS — env parsing, thread
+    # object construction) happens BEFORE the lock: every metric op in
+    # every thread contends on _lock, so it must only be held for the
+    # state swap itself. The whole stop-old/replace sequence then holds
+    # _lock once, so two racing configure() calls can never leave a
+    # leaked snap_loop thread whose stop Event was overwritten. Only
+    # t.start() runs after — if a third configure() signals our stop
+    # Event in that window, snap_loop's first wait() returns True and
+    # the thread exits immediately.
+    new_dir = os.path.abspath(dir) if dir else None
+    t = stop = None
+    if new_dir is not None:
+        os.makedirs(new_dir, exist_ok=True)
+        if snapshot_interval is None:
+            snapshot_interval = float(
+                os.environ.get("MXNET_TELEMETRY_INTERVAL", "30"))
+        if snapshot_interval > 0:
+            stop = threading.Event()
+
+            def snap_loop():
+                while not stop.wait(snapshot_interval):
+                    try:
+                        write_snapshot()
+                    except Exception as exc:  # pragma: no cover
+                        swallowed("telemetry.snap_loop", exc)
+                        return
+
+            t = threading.Thread(target=snap_loop, daemon=True,
+                                 name="mxnet_tpu-telemetry-snapshot")
     with _lock:
         fh, _state["events_fh"] = _state["events_fh"], None
         _state["events_path"] = None
@@ -367,33 +424,15 @@ def configure(dir=None, host=None, snapshot_interval=None):
                 fh.close()
             except OSError:  # pragma: no cover
                 pass
-        stop = _state["snap_stop"]
-        if stop is not None:
-            stop.set()
-        _state["snap_thread"] = _state["snap_stop"] = None
-        _state["dir"] = os.path.abspath(dir) if dir else None
+        old_stop = _state["snap_stop"]
+        if old_stop is not None:
+            old_stop.set()
+        _state["dir"] = new_dir
+        _state["snap_stop"] = stop
+        _state["snap_thread"] = t
         if host is not None:
             _state["host_id"] = int(host)
-    if _state["dir"] is None:
-        return
-    os.makedirs(_state["dir"], exist_ok=True)
-    if snapshot_interval is None:
-        snapshot_interval = float(
-            os.environ.get("MXNET_TELEMETRY_INTERVAL", "30"))
-    if snapshot_interval > 0:
-        stop = threading.Event()
-        _state["snap_stop"] = stop
-
-        def snap_loop():
-            while not stop.wait(snapshot_interval):
-                try:
-                    write_snapshot()
-                except Exception:  # pragma: no cover - disk gone
-                    return
-
-        t = threading.Thread(target=snap_loop, daemon=True,
-                             name="mxnet_tpu-telemetry-snapshot")
-        _state["snap_thread"] = t
+    if t is not None:
         t.start()
 
 
@@ -440,8 +479,8 @@ def _tap(rec):
     for cb in list(_taps):
         try:
             cb(rec)
-        except Exception:   # a broken subscriber must not break a span
-            pass
+        except Exception as exc:  # a broken subscriber must not break a span
+            swallowed("telemetry.tap", exc)
 
 
 def _emit(rec):
@@ -458,8 +497,8 @@ def _emit(rec):
                 return
             fh.write(line + "\n")
             fh.flush()  # chaos kills are the point: lines must be durable
-    except Exception:
-        pass
+    except Exception as exc:
+        swallowed("telemetry.emit", exc)
 
 
 def event(name, **args):
@@ -542,6 +581,7 @@ def flush():
             if fh is not None:
                 fh.flush()
         write_snapshot()
+    # mxanalyze: allow(swallowed-exception): atexit/os._exit path — nothing can observe a count afterwards
     except Exception:  # pragma: no cover - never break the exit path
         pass
 

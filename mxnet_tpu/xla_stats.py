@@ -63,6 +63,11 @@ registry:
    unhandled exceptions in ``Module.fit`` all dump it, so post-mortem
    state survives kills that skip ``atexit``.
 
+Lock order (checked by ``tools/mxanalyze`` lock-discipline): a
+``TrackedJit``'s per-instance ``_compile_lock`` may be held when the
+module-global ``_lock`` is taken (compile bookkeeping); never the
+reverse. Telemetry's registry lock is innermost of all.
+
 Import cost: stdlib + telemetry only — jax is imported lazily inside
 functions, so the chaos/elastic exit paths can reach the recorder even
 from processes that must stay stdlib-only at import.
@@ -282,7 +287,8 @@ def _count(name, site, help=""):
 def _flops_of(compiled):
     try:
         cost = compiled.cost_analysis()
-    except Exception:
+    except Exception as exc:
+        telemetry.swallowed("xla_stats.cost_analysis", exc)
         return None
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
@@ -302,7 +308,8 @@ def _memory_of(compiled):
                 "output_bytes": int(m.output_size_in_bytes),
                 "temp_bytes": int(m.temp_size_in_bytes),
                 "code_bytes": int(m.generated_code_size_in_bytes)}
-    except Exception:
+    except Exception as exc:
+        telemetry.swallowed("xla_stats.memory_analysis", exc)
         return None
 
 
@@ -341,6 +348,7 @@ class TrackedJit:
         self._lineage = (site, lineage if lineage is not None
                          else id(self))
         self._static = frozenset(static_argnums)
+        # mxanalyze: allow(retrace-hazard): pass-through wrapper — the static set is the caller's literal, linted at the caller's wrap site
         self._fn = jax.jit(fun, static_argnums=tuple(static_argnums),
                            **jit_kwargs)
         self._cache = {}
@@ -457,7 +465,9 @@ def tracked_jit(fun, site, static_argnums=(), lineage=None, **jit_kwargs):
     ``jax.jit`` when tracking is disabled (``MXNET_XLA_STATS=0``)."""
     if not _enabled():
         import jax
+        # mxanalyze: allow(retrace-hazard): pass-through wrapper — static_argnums is forwarded verbatim
         return jax.jit(fun, static_argnums=static_argnums, **jit_kwargs)
+    # mxanalyze: allow(retrace-hazard): pass-through wrapper — static_argnums is forwarded verbatim
     return TrackedJit(fun, site, static_argnums=static_argnums,
                       lineage=lineage, **jit_kwargs)
 
@@ -518,12 +528,14 @@ def device_memory(limit=64):
     try:
         import jax
         devs = jax.devices()
-    except Exception:
+    except Exception as exc:
+        telemetry.swallowed("xla_stats.device_memory", exc)
         return out
     for d in devs[:limit]:
         st = None
         try:
             st = d.memory_stats()
+        # mxanalyze: allow(swallowed-exception): CPU backends have no memory_stats(); zeros are the documented answer
         except Exception:
             st = None
         st = st or {}
@@ -550,13 +562,15 @@ def live_buffers():
     try:
         import jax
         arrs = jax.live_arrays()
-    except Exception:
+    except Exception as exc:
+        telemetry.swallowed("xla_stats.live_buffers", exc)
         return 0, 0
     n = len(arrs)
     b = 0
     for a in arrs:
         try:
             b += int(a.nbytes)
+        # mxanalyze: allow(swallowed-exception): a buffer deleted mid-iteration has no nbytes; skipping it is the count's semantics
         except Exception:
             pass
     telemetry.gauge("live_buffer_count",
@@ -625,7 +639,8 @@ def peak_flops_per_device():
     try:
         import jax
         kind = jax.devices()[0].device_kind.lower()
-    except Exception:
+    except Exception as exc:
+        telemetry.swallowed("xla_stats.peak_flops", exc)
         return 0.0
     for name in sorted(PEAK_FLOPS_BY_KIND, key=len, reverse=True):
         if name in kind:
@@ -642,7 +657,8 @@ def peak_flops_total():
     try:
         import jax
         return per * max(1, jax.device_count())
-    except Exception:
+    except Exception as exc:
+        telemetry.swallowed("xla_stats.peak_flops_total", exc)
         return per
 
 
@@ -784,6 +800,7 @@ class FlightRecorder:
                               help="flight-recorder post-mortem dumps "
                                    "written").inc()
             return path
+        # mxanalyze: allow(swallowed-exception): crash-path dump — a dying process must not crash harder because the disk is gone
         except Exception:   # pragma: no cover - dying process, bad disk
             return None
 
@@ -796,6 +813,7 @@ def dump_flight_recorder(reason, error=None):
     """Convenience for exit paths: dump and swallow everything."""
     try:
         return flight_recorder.dump(reason=reason, error=error)
+    # mxanalyze: allow(swallowed-exception): exit-path convenience — swallowing everything is its contract
     except Exception:   # pragma: no cover
         return None
 

@@ -1,0 +1,688 @@
+"""mxanalyze static-analysis suite: per-rule trigger + suppression
+fixtures, baseline round-trip, CLI gate conventions, and the tier-1
+assertion that the real tree is clean against the checked-in baseline.
+
+Pure AST analysis — no jax import, no device; everything here runs in
+milliseconds.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mxanalyze import analyze_paths          # noqa: E402
+from tools.mxanalyze.baseline import (             # noqa: E402
+    diff_baseline, load_baseline, save_baseline)
+
+
+def _analyze(tmp_path, source, relpath="mod.py", doc=""):
+    """Write one fixture file + env doc under tmp_path, analyze it."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    env_doc = tmp_path / "env_var.md"
+    env_doc.write_text(doc)
+    return analyze_paths([str(path)], root=str(tmp_path),
+                         env_doc=str(env_doc))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: each rule must trigger, and its suppression must hold
+# ---------------------------------------------------------------------------
+
+class TestJitPurity:
+    def test_side_effects_in_jitted_fn(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t = time.time()
+                print("tracing")
+                return x + t
+            """)
+        msgs = [f.message for f in fs if f.rule == "jit-purity"]
+        assert len(msgs) == 2, fs
+        assert any("time.time" in m for m in msgs)
+        assert any("print" in m for m in msgs)
+
+    def test_wrap_call_and_global(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import random
+            _hits = 0
+
+            def impl(x):
+                global _hits
+                _hits += 1
+                return x * random.random()
+
+            import jax
+            fwd = jax.jit(impl)
+            """)
+        msgs = [f.message for f in fs if f.rule == "jit-purity"]
+        assert any("global" in m for m in msgs)
+        assert any("random.random" in m for m in msgs)
+
+    def test_closure_mutation_and_telemetry(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+            from mxnet_tpu import telemetry
+            _cache = {}
+
+            def impl(x):
+                telemetry.counter("steps").inc()
+                _cache[1] = x
+                return x
+
+            fwd = jax.jit(impl)
+            """)
+        msgs = [f.message for f in fs if f.rule == "jit-purity"]
+        assert any("telemetry" in m for m in msgs)
+        assert any("_cache" in m for m in msgs)
+
+    def test_pure_fn_and_suppression(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import time
+            import jax
+
+            @jax.jit
+            def pure(x):
+                return x * 2
+
+            @jax.jit
+            def blessed(x):
+                # mxanalyze: allow(jit-purity): trace-time stamp is the point here
+                t = time.time()
+                return x + t
+            """)
+        assert not [f for f in fs if f.rule == "jit-purity"], fs
+
+
+class TestRetraceHazard:
+    def test_dynamic_static_argnums(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def impl(x, n):
+                return x
+
+            nums = (1,)
+            fwd = jax.jit(impl, static_argnums=tuple(nums))
+            ok = jax.jit(impl, static_argnums=(1,))
+            """)
+        hits = [f for f in fs if f.rule == "retrace-hazard"]
+        assert len(hits) == 1 and "static_argnums" in hits[0].message
+
+    def test_taint_follows_execution_order(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def impl(x, n):
+                return x
+
+            fwd = jax.jit(impl)
+
+            def late_bind_is_clean(x, k):
+                r = fwd(x, k)        # k untainted HERE
+                k = x.shape[0]       # later rebinding must not leak back
+                return r, k
+
+            def rebind_after_call_still_flags(x):
+                n = x.shape[0]
+                r = fwd(x, n)        # tainted at the call site
+                n = 0
+                return r, n
+            """)
+        hits = [f for f in fs if f.rule == "retrace-hazard"]
+        # exactly ONE finding: none from late_bind_is_clean (no
+        # retroactive taint), one from rebind_after_call_still_flags
+        # (the clearing rebind comes after the call)
+        assert len(hits) == 1, fs
+        assert "traced arg 1" in hits[0].message
+
+    def test_decorator_wrap_site_reported_once(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import functools
+            import jax
+
+            ns = [1]
+
+            @functools.partial(jax.jit, static_argnums=tuple(ns))
+            def f(x, n):
+                return x
+            """)
+        hits = [f for f in fs if f.rule == "retrace-hazard"]
+        assert len(hits) == 1, fs   # one defect, ONE finding
+
+    def test_shape_scalar_as_traced_arg(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def impl(x, n):
+                return x
+
+            fwd = jax.jit(impl)
+
+            def use(x):
+                n = x.shape[0]
+                return fwd(x, n)
+            """)
+        hits = [f for f in fs if f.rule == "retrace-hazard"]
+        assert len(hits) == 1 and "traced arg 1" in hits[0].message
+
+    def test_unhashable_static_value(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def impl(x, cfg):
+                return x
+
+            fwd = jax.jit(impl, static_argnums=(1,))
+
+            def use(x):
+                return fwd(x, [1, 2])
+            """)
+        hits = [f for f in fs if f.rule == "retrace-hazard"]
+        assert len(hits) == 1 and "unhashable" in hits[0].message
+
+    def test_serving_unbucketed_shape(self, tmp_path):
+        src = """
+            from .batching import pad_rows, pick_bucket
+
+            def bad(reqs, arr):
+                rows = sum(r.n for r in reqs)
+                return pad_rows(arr, rows)
+
+            def good(reqs, arr, buckets):
+                rows = sum(r.n for r in reqs)
+                bucket = pick_bucket(rows, buckets)
+                return pad_rows(arr, bucket)
+            """
+        fs = _analyze(tmp_path, src,
+                      relpath="mxnet_tpu/serving/myengine.py")
+        hits = [f for f in fs if f.rule == "retrace-hazard"]
+        assert len(hits) == 1, fs
+        assert "bucket ladder" in hits[0].message
+        # identical code OUTSIDE serving/ is not the engine's contract
+        fs2 = _analyze(tmp_path, src, relpath="mxnet_tpu/other.py")
+        assert not [f for f in fs2 if f.rule == "retrace-hazard"]
+
+
+class TestLockDiscipline:
+    def test_mixed_guard_writes(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            _lock = threading.Lock()
+            _state = {}
+
+            def locked():
+                with _lock:
+                    _state["x"] = 1
+
+            def unlocked():
+                _state["x"] = 2
+            """)
+        hits = [f for f in fs if f.rule == "lock-discipline"]
+        assert len(hits) == 1, fs
+        assert "_state" in hits[0].message
+        assert "without the lock" in hits[0].message
+
+    def test_init_exempt_and_self_attrs(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0          # construction: exempt
+
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+
+                def racy(self):
+                    self.n = 5
+            """)
+        hits = [f for f in fs if f.rule == "lock-discipline"]
+        assert len(hits) == 1 and "Box.n" in hits[0].message
+
+    def test_order_inversion(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            a = threading.RLock()
+            b = threading.RLock()
+
+            def one():
+                with a:
+                    with b:
+                        pass
+
+            def two():
+                with b:
+                    with a:
+                        pass
+            """)
+        hits = [f for f in fs if f.rule == "lock-discipline"]
+        assert len(hits) == 1 and "inversion" in hits[0].message
+
+    def test_nonreentrant_self_nesting(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            lk = threading.Lock()
+
+            def f():
+                with lk:
+                    with lk:
+                        pass
+            """)
+        hits = [f for f in fs if f.rule == "lock-discipline"]
+        assert len(hits) == 1 and "self-deadlock" in hits[0].message
+
+    def test_duplicate_stems_do_not_conflate(self, tmp_path):
+        """Two modules both named util.py: a lock in one must not make
+        same-named globals in the other look guarded (or vice versa)."""
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        (tmp_path / "a" / "util.py").write_text(textwrap.dedent("""
+            import threading
+            _lock = threading.Lock()
+            _cache = {}
+
+            def locked():
+                with _lock:
+                    _cache["k"] = 1
+
+            def unlocked():
+                _cache["k"] = 2
+            """))
+        (tmp_path / "b" / "util.py").write_text(textwrap.dedent("""
+            _cache = {}
+
+            def lockfree():
+                _cache["k"] = 3   # this module has NO locks: clean
+            """))
+        env_doc = tmp_path / "env_var.md"
+        env_doc.write_text("")
+        fs = analyze_paths([str(tmp_path / "a"), str(tmp_path / "b")],
+                           root=str(tmp_path), env_doc=str(env_doc))
+        hits = [f for f in fs if f.rule == "lock-discipline"]
+        assert len(hits) == 1, fs
+        assert hits[0].path == "a/util.py"
+
+    def test_suppression(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            _lock = threading.Lock()
+            _state = {}
+
+            def locked():
+                with _lock:
+                    _state["x"] = 1
+
+            def unlocked():
+                # mxanalyze: allow(lock-discipline): single-threaded setup path
+                _state["x"] = 2
+            """)
+        assert not [f for f in fs if f.rule == "lock-discipline"], fs
+
+
+class TestSwallowedException:
+    def test_silent_broad_handler(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    pass
+            """)
+        hits = [f for f in fs if f.rule == "swallowed-exception"]
+        assert len(hits) == 1
+
+    def test_logged_counted_raised_ok(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import logging
+            from mxnet_tpu import telemetry
+
+            def a():
+                try:
+                    risky()
+                except Exception as exc:
+                    logging.debug("boom %s", exc)
+
+            def b():
+                try:
+                    risky()
+                except Exception as exc:
+                    telemetry.swallowed("test.site", exc)
+
+            def c():
+                try:
+                    risky()
+                except Exception:
+                    raise RuntimeError("wrapped")
+
+            def d():
+                try:
+                    risky()
+                except ValueError:   # narrow: out of scope
+                    pass
+            """)
+        assert not [f for f in fs if f.rule == "swallowed-exception"], fs
+
+    def test_suppression_with_reason(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def f():
+                try:
+                    risky()
+                # mxanalyze: allow(swallowed-exception): exit path, nothing can observe it
+                except Exception:
+                    pass
+            """)
+        assert not [f for f in fs if f.rule == "swallowed-exception"], fs
+
+    def test_reasonless_suppression_rejected(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def f():
+                try:
+                    risky()
+                # mxanalyze: allow(swallowed-exception)
+                except Exception:
+                    pass
+            """)
+        assert [f for f in fs if f.rule == "swallowed-exception"]
+        assert [f for f in fs if f.rule == "bad-suppression"]
+
+
+class TestEnvVarDrift:
+    DOC = "| `MXNET_DOCUMENTED_KNOB` | `0` | A knob. |\n" \
+          "| `MXNET_FAMILY_*` | - | Wildcard family. |\n"
+
+    def test_undocumented_read_flagged(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import os
+            A = os.environ.get("MXNET_DOCUMENTED_KNOB", "0")
+            B = os.environ.get("MXNET_MYSTERY_KNOB", "0")
+            C = os.getenv("MXNET_FAMILY_DEPTH")
+            D = os.environ["MXNET_MYSTERY_SUBSCRIPT"]
+            """, doc=self.DOC)
+        hits = sorted(f.message.split()[2] for f in fs
+                      if f.rule == "env-var-drift")
+        assert hits == ["MXNET_MYSTERY_KNOB", "MXNET_MYSTERY_SUBSCRIPT"]
+
+    def test_from_env_prefix_expansion(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            from mxnet_tpu.parallel.retry import RetryPolicy
+            p = RetryPolicy.from_env("MXNET_NEWLOOP", max_attempts=2)
+            """, doc=self.DOC)
+        names = sorted(f.message.split()[2] for f in fs
+                       if f.rule == "env-var-drift")
+        assert names == ["MXNET_NEWLOOP_BASE_DELAY",
+                         "MXNET_NEWLOOP_MAX_ATTEMPTS",
+                         "MXNET_NEWLOOP_MAX_DELAY"]
+
+    def test_docstring_mention_is_not_a_read(self, tmp_path):
+        fs = _analyze(tmp_path, '''
+            """Talks about MXNET_IMAGINARY_KNOB but never reads it."""
+            X = 1
+            ''', doc=self.DOC)
+        assert not [f for f in fs if f.rule == "env-var-drift"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    SRC = """
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+        """
+
+    def test_roundtrip_then_new_then_stale(self, tmp_path):
+        fs = _analyze(tmp_path, self.SRC)
+        assert fs
+        bl_path = tmp_path / "baseline.json"
+        save_baseline(str(bl_path), fs)
+        bl = load_baseline(str(bl_path))
+
+        new, baselined, stale = diff_baseline(fs, bl)
+        assert not new and not stale and len(baselined) == len(fs)
+
+        # a SECOND identical handler in the same file exceeds the count
+        fs2 = _analyze(tmp_path, self.SRC + """
+        def g():
+            try:
+                risky()
+            except Exception:
+                pass
+        """)
+        new, baselined, stale = diff_baseline(fs2, bl)
+        assert len(new) == 1 and not stale
+
+        # fixing everything leaves the entry stale
+        new, baselined, stale = diff_baseline([], bl)
+        assert not new and sum(stale.values()) == len(fs)
+
+    def test_fingerprint_is_line_independent(self, tmp_path):
+        fs = _analyze(tmp_path, self.SRC)
+        shifted = _analyze(tmp_path, "\n\n# padding\n\n"
+                           + textwrap.dedent(self.SRC))
+        assert [f.fingerprint() for f in fs] == \
+            [f.fingerprint() for f in shifted]
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + BENCH-style gate line (bench_gate conventions)
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mxanalyze"] + args,
+        capture_output=True, text=True, cwd=cwd,
+        env=dict(os.environ, PYTHONPATH=REPO))
+
+
+class TestCLI:
+    def _tmp_repo(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    pass
+            """))
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        bl = tmp_path / "bl.json"
+        return bad, doc, bl
+
+    def test_violation_fails_then_baseline_passes(self, tmp_path):
+        bad, doc, bl = self._tmp_repo(tmp_path)
+        common = [str(bad), "--baseline", str(bl), "--env-doc", str(doc)]
+        r = _run_cli(["--strict"] + common)
+        assert r.returncode == 1, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["metric"] == "mxanalyze_gate"
+        assert gate["status"] == "fail" and gate["new"] == 1
+
+        r = _run_cli(["--update-baseline"] + common)
+        assert r.returncode == 0
+
+        r = _run_cli(["--strict"] + common)
+        assert r.returncode == 0, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["status"] == "pass" and gate["baselined"] == 1
+
+    def test_scoped_update_preserves_out_of_scope_entries(self, tmp_path):
+        """--update-baseline over a subdir must not drop recorded debt
+        for files outside that subdir."""
+        sub_a, sub_b = tmp_path / "a", tmp_path / "b"
+        sub_a.mkdir(), sub_b.mkdir()
+        src = textwrap.dedent("""
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    pass
+            """)
+        (sub_a / "m.py").write_text(src)
+        (sub_b / "m.py").write_text(src)
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        bl = tmp_path / "bl.json"
+        common = ["--baseline", str(bl), "--env-doc", str(doc)]
+        r = _run_cli(["--update-baseline", str(sub_a), str(sub_b)]
+                     + common)
+        assert r.returncode == 0
+        full = load_baseline(str(bl))
+        assert len(full) == 2
+        # a path-scoped --strict run must not call the unanalyzed b
+        # entry stale
+        r = _run_cli(["--strict", str(sub_a)] + common)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # fix b's finding, scoped-update only b: a's entry must survive
+        (sub_b / "m.py").write_text("def f():\n    return 1\n")
+        r = _run_cli(["--update-baseline", str(sub_b)] + common)
+        assert r.returncode == 0, r.stdout + r.stderr
+        after = load_baseline(str(bl))
+        assert len(after) == 1 and list(after)[0][1].endswith("a/m.py"), \
+            dict(after)
+        # and the full-tree gate still passes against the merged file
+        r = _run_cli(["--strict", str(sub_a), str(sub_b)] + common)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_corrupt_baseline_is_usage_error_not_gate_result(self,
+                                                             tmp_path):
+        bad, doc, bl = self._tmp_repo(tmp_path)
+        bl.write_text("<<<<<<< conflict markers\n{not json")
+        r = _run_cli([str(bad), "--baseline", str(bl), "--env-doc",
+                      str(doc)])
+        assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+        assert "not valid JSON" in r.stderr
+
+    def test_nonexistent_path_is_an_error_not_a_pass(self, tmp_path):
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        r = _run_cli([str(tmp_path / "no_such_dir"), "--env-doc",
+                      str(doc)])
+        assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+        assert "does not exist" in r.stderr
+
+    def test_strict_fails_on_stale_entry(self, tmp_path):
+        bad, doc, bl = self._tmp_repo(tmp_path)
+        common = [str(bad), "--baseline", str(bl), "--env-doc", str(doc)]
+        _run_cli(["--update-baseline"] + common)
+        bad.write_text("def f():\n    return 1\n")   # finding fixed
+        r = _run_cli(common)               # lenient: warn only
+        assert r.returncode == 0
+        r = _run_cli(["--strict"] + common)
+        assert r.returncode == 1
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["stale"] == 1
+
+    def test_one_violation_of_each_rule_fails(self, tmp_path):
+        """The acceptance drill: each of the five rules, inserted fresh,
+        flips the gate to non-zero on its own."""
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        bl = tmp_path / "bl.json"   # absent: empty baseline
+        snippets = {
+            "jit-purity": """
+                import time, jax
+                @jax.jit
+                def f(x):
+                    return x + time.time()
+                """,
+            "retrace-hazard": """
+                import jax
+                def impl(x):
+                    return x
+                nums = [0]
+                f = jax.jit(impl, static_argnums=tuple(nums))
+                """,
+            "lock-discipline": """
+                import threading
+                _lock = threading.Lock()
+                _s = {}
+                def a():
+                    with _lock:
+                        _s["k"] = 1
+                def b():
+                    _s["k"] = 2
+                """,
+            "swallowed-exception": """
+                def f():
+                    try:
+                        risky()
+                    except Exception:
+                        pass
+                """,
+            "env-var-drift": """
+                import os
+                X = os.environ.get("MXNET_UNDOCUMENTED", "0")
+                """,
+        }
+        for rule, src in snippets.items():
+            p = tmp_path / ("%s.py" % rule.replace("-", "_"))
+            p.write_text(textwrap.dedent(src))
+            r = _run_cli(["--strict", str(p), "--baseline", str(bl),
+                          "--env-doc", str(doc)])
+            assert r.returncode == 1, (rule, r.stdout, r.stderr)
+            assert rule in r.stdout, (rule, r.stdout)
+            p.unlink()
+
+
+# ---------------------------------------------------------------------------
+# tier-1: the real tree is clean against the checked-in baseline
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_mxnet_tpu_clean_against_baseline(self):
+        findings = analyze_paths(["mxnet_tpu"], root=REPO)
+        bl = load_baseline(os.path.join(REPO, "tools", "mxanalyze",
+                                        "baseline.json"))
+        new, baselined, stale = diff_baseline(findings, bl)
+        assert not new, "new findings:\n%s" % "\n".join(
+            f.render() for f in new)
+        assert not stale, "stale baseline entries (fixed findings — " \
+            "run --update-baseline): %r" % stale
+
+    def test_env_var_drift_is_zero_with_no_baseline_entries(self):
+        findings = analyze_paths(["mxnet_tpu"], root=REPO)
+        drift = [f for f in findings if f.rule == "env-var-drift"]
+        assert not drift, "\n".join(f.render() for f in drift)
+        bl = load_baseline(os.path.join(REPO, "tools", "mxanalyze",
+                                        "baseline.json"))
+        assert not [fp for fp in bl if fp[0] == "env-var-drift"]
+
+    def test_repo_gate_cli(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "repo_gate.py")],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["metric"] == "mxanalyze_gate"
+        assert gate["status"] == "pass"
+
+    def test_known_rules_registry(self):
+        from tools.mxanalyze import RULES
+        for rule in ("jit-purity", "retrace-hazard", "lock-discipline",
+                     "swallowed-exception", "env-var-drift"):
+            assert rule in RULES

@@ -1,0 +1,20 @@
+"""mxanalyze: JAX-aware static analysis for the mxnet_tpu tree.
+
+AST-level (stdlib ``ast``, no third-party deps) checks for the
+invariants the runtime can only count after the fact — jit purity,
+retrace hazards, lock discipline, swallowed exceptions, env-var drift —
+run as a repo gate next to ``tools/bench_gate.py``.
+
+CLI::
+
+    python -m tools.mxanalyze [--strict] [--update-baseline] [paths...]
+
+Design note: ``docs/architecture/static_analysis.md``.
+"""
+from .core import (Finding, Project, SourceModule, RULES, SEVERITY,
+                   analyze_paths, repo_root)
+from .baseline import load_baseline, save_baseline, diff_baseline
+
+__all__ = ["Finding", "Project", "SourceModule", "RULES", "SEVERITY",
+           "analyze_paths", "repo_root", "load_baseline", "save_baseline",
+           "diff_baseline"]

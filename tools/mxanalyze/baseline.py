@@ -1,0 +1,90 @@
+"""Checked-in baseline for incremental adoption.
+
+``baseline.json`` records the findings the tree is ALLOWED to have —
+pre-existing debt, adopted without a flag day. The gate then fails only
+on findings beyond the baseline ("new"), and ``--strict`` additionally
+fails on *stale* entries (baselined findings that no longer exist —
+somebody fixed debt and must shrink the baseline with
+``--update-baseline``, so the recorded debt only ever goes down).
+
+Fingerprints are ``(rule, path, message)`` with a count per
+fingerprint — line numbers are excluded so edits above a baselined
+finding do not churn the file.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+
+def default_baseline_path():
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path):
+    """fingerprint -> allowed count. Absent file = empty baseline; a
+    CORRUPT file (conflict markers, hand-edit damage) raises ValueError
+    with the path named — the gate must fail loudly as a usage error,
+    not silently treat recorded debt as gone."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError:
+        return Counter()
+    except ValueError as exc:
+        raise ValueError("baseline %s is not valid JSON (%s) — fix it "
+                         "or regenerate with --update-baseline"
+                         % (path, exc)) from exc
+    out = Counter()
+    try:
+        for rec in doc.get("entries", []):
+            fp = (rec["rule"], rec["path"], rec["message"])
+            out[fp] += int(rec.get("count", 1))
+    except (AttributeError, KeyError, TypeError, ValueError) as exc:
+        raise ValueError("baseline %s is malformed (%s: %s) — "
+                         "regenerate with --update-baseline"
+                         % (path, type(exc).__name__, exc)) from exc
+    return out
+
+
+def save_baseline(path, findings, keep=None):
+    """Write the baseline from ``findings``; ``keep`` (fingerprint ->
+    count) carries entries OUTSIDE the analyzed scope that a subset
+    update must preserve rather than silently drop."""
+    counts = Counter(f.fingerprint() for f in findings)
+    for fp, n in (keep or {}).items():
+        counts[fp] += n
+    entries = [{"rule": fp[0], "path": fp[1], "message": fp[2],
+                "count": n}
+               for fp, n in sorted(counts.items())]
+    doc = {"version": 1, "tool": "mxanalyze", "entries": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def diff_baseline(findings, baseline):
+    """Split findings into (new, baselined) and report stale entries.
+
+    Per fingerprint, the first ``allowed`` instances (line order) are
+    baselined; any beyond that are new. Returns
+    ``(new, baselined, stale)`` where ``stale`` is a dict
+    fingerprint -> count of baseline entries with no live finding.
+    """
+    new, baselined = [], []
+    used = Counter()
+    for f in sorted(findings, key=lambda f: f.sort_key()):
+        fp = f.fingerprint()
+        if used[fp] < baseline.get(fp, 0):
+            used[fp] += 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = {}
+    for fp, allowed in baseline.items():
+        if used[fp] < allowed:
+            stale[fp] = allowed - used[fp]
+    return new, baselined, stale

@@ -1,0 +1,115 @@
+"""CLI: ``python -m tools.mxanalyze [--strict] [--update-baseline]
+[paths...]``.
+
+Exit codes follow ``tools/bench_gate.py``: 0 = gate passes, 1 = gate
+fails, 2 = usage error; the last stdout line is a BENCH-style JSON
+record (``{"metric": "mxanalyze_gate", "status": ...}``) so the same
+log-scraping that gates perf regressions gates analysis regressions.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .baseline import (default_baseline_path, diff_baseline,
+                       load_baseline, save_baseline)
+from .core import (RULES, analyze_paths, repo_root,
+                   scope_prefixes)
+
+DEFAULT_PATHS = ["mxnet_tpu"]
+
+
+def gate_line(status, detail, out=sys.stdout, **extra):
+    rec = dict({"metric": "mxanalyze_gate", "status": status,
+                "detail": detail}, **extra)
+    out.write(json.dumps(rec) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxanalyze",
+        description="JAX-aware static analysis gate (rules: %s)"
+                    % ", ".join(sorted(RULES)))
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze (default: mxnet_tpu/)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run and exit 0")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/mxanalyze/"
+                         "baseline.json)")
+    ap.add_argument("--env-doc", default=None,
+                    help="env-var doc the drift pass checks against "
+                         "(default: docs/env_var.md)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="findings output format")
+    ap.add_argument("--all", action="store_true",
+                    help="print baselined findings too, not just new")
+    args = ap.parse_args(argv)
+
+    root = repo_root()
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        findings = analyze_paths(paths, root=root, env_doc=args.env_doc)
+    except OSError as exc:
+        print("mxanalyze: %s" % exc, file=sys.stderr)
+        return 2
+
+    # every run is scoped — the default run to DEFAULT_PATHS — and
+    # baseline entries OUTSIDE the scope are invisible to it: an update
+    # must preserve them and --strict must not call them stale
+    scope = scope_prefixes(paths, root)
+
+    def in_scope(fp):
+        return any(fp[1] == p or fp[1].startswith(p) for p in scope)
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.update_baseline:
+        try:
+            old = load_baseline(baseline_path)
+        except ValueError as exc:
+            print("mxanalyze: %s (a scoped --update-baseline needs "
+                  "the existing entries to merge)" % exc,
+                  file=sys.stderr)
+            return 2
+        keep = {fp: n for fp, n in old.items() if not in_scope(fp)}
+        n = save_baseline(baseline_path, findings, keep=keep)
+        gate_line("pass", "baseline rewritten: %d entries (%d findings, "
+                  "%d kept out-of-scope) -> %s"
+                  % (n, len(findings), sum(keep.values()), baseline_path),
+                  findings=len(findings), entries=n)
+        return 0
+
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as exc:
+        print("mxanalyze: %s" % exc, file=sys.stderr)
+        return 2
+    new, baselined, stale = diff_baseline(findings, baseline)
+    stale = {fp: n for fp, n in stale.items() if in_scope(fp)}
+
+    shown = findings if args.all else new
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in shown],
+            "new": len(new), "baselined": len(baselined),
+            "stale": sum(stale.values())}, indent=1))
+    else:
+        for f in shown:
+            tag = "" if f in new else " [baselined]"
+            print(f.render() + tag)
+        for fp, n in sorted(stale.items()):
+            print("stale baseline entry (finding fixed -- run "
+                  "--update-baseline): [%s] %s: %s (x%d)"
+                  % (fp[0], fp[1], fp[2], n))
+
+    failed = bool(new) or (args.strict and stale)
+    detail = ("%d new finding(s)" % len(new) if new else
+              "%d stale baseline entr(ies)" % sum(stale.values())
+              if args.strict and stale else
+              "clean: %d finding(s), all baselined" % len(baselined))
+    gate_line("fail" if failed else "pass", detail, new=len(new),
+              baselined=len(baselined), stale=sum(stale.values()))
+    return 1 if failed else 0

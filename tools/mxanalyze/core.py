@@ -1,0 +1,284 @@
+"""Framework spine: findings model, suppression comments, source model,
+pass registry, and the analysis driver.
+
+Everything operates on parsed ``ast`` trees plus the raw source lines —
+no imports of the analyzed code, so the analyzer runs in milliseconds
+and cannot be affected by (or affect) runtime state.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+
+#: rule id -> one-line description (the registry the CLI prints)
+RULES = {
+    "jit-purity": "Python side effects lexically inside jit/Pallas-"
+                  "wrapped functions run at trace time only",
+    "retrace-hazard": "patterns that unbound the XLA signature set "
+                      "(dynamic static_argnums, shape-derived scalars as "
+                      "traced args, unbucketed serving shapes)",
+    "lock-discipline": "state written both under and outside a lock, "
+                       "inconsistent lock acquisition order, nested "
+                       "non-reentrant locks",
+    "swallowed-exception": "broad except handlers that neither raise, "
+                           "log, nor bump a telemetry counter",
+    "env-var-drift": "MXNET_* env var read in code but undocumented in "
+                     "docs/env_var.md",
+    "bad-suppression": "malformed mxanalyze suppression comment",
+    "parse-error": "file could not be parsed",
+}
+
+SEVERITY = {
+    "jit-purity": "error",
+    "retrace-hazard": "warning",
+    "lock-discipline": "warning",
+    "swallowed-exception": "warning",
+    "env-var-drift": "error",
+    "bad-suppression": "warning",
+    "parse-error": "error",
+}
+
+
+def repo_root():
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+class Finding:
+    """One diagnostic: rule id, severity, location, message, fix hint.
+
+    The baseline fingerprint is ``(rule, path, message)`` — line numbers
+    are deliberately excluded so unrelated edits above a baselined
+    finding do not churn ``baseline.json``.
+    """
+
+    __slots__ = ("rule", "path", "line", "col", "message", "hint")
+
+    def __init__(self, rule, path, line, col, message, hint=""):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.hint = hint
+
+    @property
+    def severity(self):
+        return SEVERITY.get(self.rule, "warning")
+
+    def fingerprint(self):
+        return (self.rule, self.path, self.message)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "hint": self.hint}
+
+    def render(self):
+        out = "%s:%d:%d: [%s] %s: %s" % (
+            self.path, self.line, self.col, self.rule, self.severity,
+            self.message)
+        if self.hint:
+            out += " (hint: %s)" % self.hint
+        return out
+
+    def __repr__(self):
+        return "Finding(%s)" % self.render()
+
+
+# ---------------------------------------------------------------------------
+# Suppression comments
+# ---------------------------------------------------------------------------
+#
+#   x = eval(s)  # mxanalyze: allow(jit-purity): trace-time by design
+#
+# applies to its own physical line; a comment-only line also covers the
+# next line. Multiple rules: allow(rule-a, rule-b); allow(*) covers all.
+# The ": <reason>" is REQUIRED — a reasonless allow() does not suppress
+# and is itself reported as `bad-suppression`.
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxanalyze:\s*allow\(\s*([^)]*)\s*\)\s*(?::\s*(\S.*))?")
+
+
+def _parse_suppressions(text, path):
+    """(line -> set(rule)), plus bad-suppression findings.
+
+    Parsed from the tokenizer's COMMENT tokens, not raw lines — an
+    ``allow(...)`` inside a string literal (help text, test fixture)
+    must neither suppress anything nor be flagged as malformed."""
+    supp = {}
+    findings = []
+    import io
+    try:
+        tokens = [t for t in tokenize.generate_tokens(
+            io.StringIO(text).readline) if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return supp, findings   # parse-error finding covers the file
+    for tok in tokens:
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        i, col = tok.start
+        rules_raw, reason = m.group(1), m.group(2)
+        rules = {r.strip() for r in rules_raw.split(",") if r.strip()}
+        bad = [r for r in rules if r != "*" and r not in RULES]
+        if not reason or not rules or bad:
+            detail = ("unknown rule(s) %s" % ", ".join(sorted(bad))
+                      if bad else "missing ': <reason>'"
+                      if not reason else "empty rule list")
+            findings.append(Finding(
+                "bad-suppression", path, i, col,
+                "suppression comment is malformed (%s) and does not "
+                "suppress anything" % detail,
+                hint="write `# mxanalyze: allow(<rule>): <reason>`"))
+            continue
+        targets = [i]
+        if tok.line[:col].strip() == "":
+            targets.append(i + 1)   # standalone comment covers next line
+        for ln in targets:
+            supp.setdefault(ln, set()).update(rules)
+    return supp, findings
+
+
+# ---------------------------------------------------------------------------
+# Source model
+# ---------------------------------------------------------------------------
+
+class SourceModule:
+    """One parsed file: tree + lines + suppression map."""
+
+    def __init__(self, path, relpath, text):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.suppressions, self.own_findings = _parse_suppressions(
+            text, self.relpath)
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as exc:
+            self.tree = None
+            self.own_findings.append(Finding(
+                "parse-error", self.relpath, exc.lineno or 1, 0,
+                "syntax error: %s" % exc.msg))
+
+    @property
+    def stem(self):
+        return os.path.splitext(os.path.basename(self.relpath))[0]
+
+    def suppressed(self, line, rule):
+        rules = self.suppressions.get(line)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+
+class Project:
+    """All modules under analysis plus repo-level context."""
+
+    def __init__(self, modules, root=None, env_doc=None):
+        self.modules = modules
+        self.root = root or repo_root()
+        self.env_doc = env_doc or os.path.join(self.root, "docs",
+                                               "env_var.md")
+
+
+def iter_py_files(paths, root):
+    """Sorted .py files under ``paths`` (files or directories),
+    __pycache__ pruned."""
+    out = []
+    for p in paths:
+        ap = resolve_path(p, root)
+        if os.path.isfile(ap):
+            out.append(ap)
+            continue
+        if not os.path.isdir(ap):
+            # a typo'd CI path must not silently gate zero files as pass
+            raise OSError("path %r does not exist (resolved to %s)"
+                          % (p, ap))
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def resolve_path(p, root):
+    """Resolve one CLI path argument: absolute as-is; relative against
+    the cwd first (normal CLI convention), then the repo root (so
+    ``-m tools.mxanalyze mxnet_tpu/`` works from anywhere, e.g. a CI
+    step with its own cwd). The ONE resolution rule — analysis scope
+    and baseline-update scope must never disagree."""
+    if os.path.isabs(p):
+        return p
+    ap = os.path.abspath(p)
+    return ap if os.path.exists(ap) else os.path.join(root, p)
+
+
+def scope_prefixes(paths, root):
+    """Repo-relative coverage of ``paths``: exact relpaths for files,
+    ``<relpath>/`` prefixes for directories — so a scoped
+    ``--update-baseline`` / ``--strict`` knows which baseline entries
+    the run can actually see."""
+    out = []
+    for p in paths:
+        ap = resolve_path(p, root)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        if rel == ".":
+            out.append("")   # the repo root: matches every entry
+        elif os.path.isfile(ap):
+            out.append(rel)
+        else:
+            out.append(rel.rstrip("/") + "/")
+    return out
+
+
+def load_modules(paths, root):
+    mods = []
+    for path in iter_py_files(paths, root):
+        rel = os.path.relpath(path, root)
+        try:
+            with tokenize.open(path) as fh:   # honors coding cookies
+                text = fh.read()
+        except (OSError, UnicodeDecodeError, SyntaxError) as exc:
+            mods.append(SourceModule.__new__(SourceModule))
+            m = mods[-1]
+            m.path, m.relpath, m.text, m.lines = path, rel, "", []
+            m.suppressions, m.tree = {}, None
+            m.own_findings = [Finding("parse-error", rel, 1, 0,
+                                      "unreadable: %s" % exc)]
+            continue
+        mods.append(SourceModule(path, rel, text))
+    return mods
+
+
+def analyze_paths(paths, root=None, env_doc=None, passes=None):
+    """Run every registered pass over ``paths``; returns the sorted,
+    suppression-filtered finding list."""
+    from .passes import ALL_PASSES
+    root = root or repo_root()
+    project = Project(load_modules(paths, root), root=root,
+                      env_doc=env_doc)
+    findings = []
+    for mod in project.modules:
+        findings.extend(mod.own_findings)
+    for ps in (passes if passes is not None else ALL_PASSES):
+        findings.extend(ps.run(project))
+    by_rel = {m.relpath: m for m in project.modules}
+    kept = []
+    for f in findings:
+        mod = by_rel.get(f.path)
+        if mod is not None and f.rule != "bad-suppression" \
+                and mod.suppressed(f.line, f.rule):
+            continue
+        kept.append(f)
+    kept.sort(key=Finding.sort_key)
+    return kept

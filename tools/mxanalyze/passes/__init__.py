@@ -1,0 +1,8 @@
+"""Pass registry: one module per rule, each exporting ``PASS``."""
+from . import envvars, jit_purity, locks, retrace, swallowed
+
+#: run order is reporting order for ties; findings are re-sorted anyway.
+ALL_PASSES = [jit_purity.PASS, retrace.PASS, locks.PASS, swallowed.PASS,
+              envvars.PASS]
+
+__all__ = ["ALL_PASSES"]

@@ -1,0 +1,319 @@
+"""Shared AST utilities for the passes: dotted-name flattening, literal
+extraction, a per-module jit index (who is jit-wrapped, which argument
+positions are static), and scope helpers."""
+from __future__ import annotations
+
+import ast
+
+#: callables that wrap a Python function into a compiled/traced one.
+JIT_WRAPPER_TAILS = ("jit", "tracked_jit", "pallas_call", "TrackedJit",
+                    "checkpoint", "remat")
+
+
+def dotted_parts(node):
+    """Flatten a Name/Attribute chain into its name parts, unwrapping
+    intermediate calls: ``telemetry.counter(...).inc`` ->
+    ``["telemetry", "counter", "inc"]``. Returns [] when the base is not
+    name-like (e.g. a subscript)."""
+    parts = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return list(reversed(parts))
+        else:
+            return []
+
+
+def dotted_str(node):
+    return ".".join(dotted_parts(node))
+
+
+def const_int(node):
+    """The int value of a literal (allowing unary minus), else None."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = const_int(node.operand)
+        return None if inner is None else -inner
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def literal_int_seq(node):
+    """ints of a literal int / tuple-or-list of literal ints; None when
+    the expression is anything else (i.e. dynamically constructed)."""
+    v = const_int(node)
+    if v is not None:
+        return [v]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            v = const_int(elt)
+            if v is None:
+                return None
+            out.append(v)
+        return out
+    return None
+
+
+def is_jit_wrap_call(call):
+    """True when ``call`` wraps a function for tracing: jax.jit(f),
+    tracked_jit(f, site), pl.pallas_call(kernel, ...), TrackedJit(f, s),
+    functools.partial(jax.jit, ...)(f) is NOT handled here (see
+    partial_jit_target)."""
+    parts = dotted_parts(call.func)
+    if not parts:
+        return False
+    if parts[-1] in JIT_WRAPPER_TAILS:
+        # `self.jit(...)` etc. still counts; a bare `jit` must not be a
+        # local variable named jit — acceptable over-approximation.
+        return True
+    return False
+
+
+def partial_jit_inner(call):
+    """For ``partial(jax.jit, static_argnums=...)``: the inner jit call
+    node-ish (returns the partial call itself when its first arg is a
+    jit wrapper reference), else None."""
+    parts = dotted_parts(call.func)
+    if parts and parts[-1] == "partial" and call.args:
+        first = dotted_parts(call.args[0])
+        if first and first[-1] in JIT_WRAPPER_TAILS:
+            return call
+    return None
+
+
+def wrapped_function_ref(call):
+    """The AST node of the function being wrapped by a jit-wrap call:
+    a Name (resolve against module defs), Lambda, or an inline def via
+    decorator handled elsewhere. None when not identifiable."""
+    if not call.args:
+        return None
+    arg0 = call.args[0]
+    if isinstance(arg0, ast.Call) \
+            and dotted_parts(arg0.func)[-1:] == ["partial"] \
+            and arg0.args:
+        arg0 = arg0.args[0]   # pallas_call(partial(kernel, ...), ...)
+    if isinstance(arg0, (ast.Name, ast.Lambda)):
+        return arg0
+    if isinstance(arg0, ast.Attribute) \
+            and isinstance(arg0.value, ast.Name) \
+            and arg0.value.id == "self":
+        return arg0   # self.method — resolved against the class
+    return None
+
+
+def static_positions(call):
+    """Static argument positions declared on a jit-wrap call, and
+    whether the declaration is a clean literal. Returns
+    ``(positions or None, dynamic_node or None)`` — ``dynamic_node`` is
+    the offending expression when static_argnums is not a literal."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            seq = literal_int_seq(kw.value)
+            if seq is None:
+                return None, kw.value
+            return set(seq), None
+    return set(), None
+
+
+class JitIndex:
+    """Per-module map of jit-wrapped functions and jitted callables.
+
+    - ``jitted_defs``: FunctionDef/AsyncFunctionDef/Lambda nodes whose
+      bodies are traced (decorator or first-arg reference).
+    - ``jitted_names``: dotted name (as written at the assignment, e.g.
+      ``self._fwd`` or ``step``) -> set of static positions (None when
+      unknown/dynamic), for call-site checks.
+    - ``wrap_calls``: every jit-wrap Call node (for static_argnums
+      linting).
+    """
+
+    def __init__(self, module):
+        self.jitted_defs = []
+        self.jitted_names = {}
+        self.wrap_calls = []
+        if module.tree is None:
+            return
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        # class -> {method name: def}, for `tracked_jit(self.method, ..)`
+        methods = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                methods[node.name] = {
+                    m.name: m for m in node.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        # `kern = functools.partial(_kernel, ...)` indirection: resolve
+        # the alias to the underlying def (the Pallas idiom)
+        partial_alias = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted_parts(node.value.func)[-1:] == ["partial"] \
+                    and node.value.args \
+                    and isinstance(node.value.args[0], ast.Name):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        partial_alias.setdefault(tgt.id, set()).add(
+                            node.value.args[0].id)
+        # call node -> enclosing class name (for self.method resolution)
+        call_class = {}
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                for sub in ast.walk(cls):
+                    if isinstance(sub, ast.Call):
+                        call_class[id(sub)] = cls.name
+        seen = set()
+        wrap_seen = set()
+
+        def mark(node):
+            if id(node) not in seen:
+                seen.add(id(node))
+                self.jitted_defs.append(node)
+
+        def add_wrap(call):
+            # a decorator Call is ALSO reached by ast.walk — record each
+            # wrap site once or static_argnums lints double-count
+            if id(call) not in wrap_seen:
+                wrap_seen.add(id(call))
+                self.wrap_calls.append(call)
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    parts = dotted_parts(target)
+                    inner = None
+                    if isinstance(dec, ast.Call):
+                        inner = partial_jit_inner(dec)
+                    if (parts and parts[-1] in JIT_WRAPPER_TAILS) or inner:
+                        mark(node)
+                        if isinstance(dec, ast.Call):
+                            add_wrap(dec)
+            if not isinstance(node, ast.Call):
+                continue
+            call = node
+            if partial_jit_inner(call) is not None:
+                add_wrap(call)
+                continue
+            if not is_jit_wrap_call(call):
+                continue
+            add_wrap(call)
+            ref = wrapped_function_ref(call)
+            if isinstance(ref, ast.Lambda):
+                mark(ref)
+            elif isinstance(ref, ast.Name):
+                names = {ref.id} if ref.id in defs \
+                    else partial_alias.get(ref.id, set())
+                for name in names:
+                    for d in defs.get(name, ()):
+                        mark(d)
+            elif isinstance(ref, ast.Attribute):   # self.method
+                cls = call_class.get(id(call))
+                target = methods.get(cls, {}).get(ref.attr)
+                if target is not None:
+                    mark(target)
+
+        # names bound to jit-wrapped callables: `f = jax.jit(g, ...)`,
+        # `self._fwd = tracked_jit(step, "site")`
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if not (is_jit_wrap_call(call)
+                    or partial_jit_inner(call) is not None):
+                continue
+            pos, dyn = static_positions(call)
+            for tgt in node.targets:
+                name = dotted_str(tgt)
+                if name:
+                    self.jitted_names[name] = (None if dyn is not None
+                                               else pos)
+
+
+def jit_index(module):
+    """Memoized :class:`JitIndex` for a module — the jit-purity and
+    retrace-hazard passes share one instance instead of each paying the
+    multi-traversal construction (and risking divergent views)."""
+    ix = getattr(module, "_jit_index", None)
+    if ix is None:
+        ix = JitIndex(module)
+        module._jit_index = ix
+    return ix
+
+
+def local_bindings(fn):
+    """Over-approximate set of names bound inside ``fn`` (params,
+    assignments, loop/with/except/comprehension targets, inner defs),
+    nested scopes included."""
+    names = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+    return names
+
+
+def module_globals(tree):
+    """Names assigned at module top level."""
+    names = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    for e in tgt.elts:
+                        if isinstance(e, ast.Name):
+                            names.add(e.id)
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+def import_aliases(tree):
+    """bound name -> full dotted import target (relative dots dropped):
+    ``import time as _t`` -> {"_t": "time"};
+    ``from jax import random`` -> {"random": "jax.random"};
+    ``from .. import telemetry`` -> {"telemetry": "telemetry"}."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".")[0]
+                    out[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                target = alias.name if not node.module \
+                    else "%s.%s" % (node.module, alias.name)
+                if node.level:   # relative: inside this package, never a
+                    # stdlib module — anchor it so `from .. import random`
+                    # cannot shadow stdlib deny prefixes
+                    target = "mxnet_tpu." + target
+                out[alias.asname or alias.name] = target
+    return out

@@ -1,0 +1,118 @@
+"""env-var-drift: every ``MXNET_*`` read in code must have a row in
+``docs/env_var.md``.
+
+Readers recognized: ``os.environ.get/setdefault``, ``os.environ[...]``,
+``os.getenv``, the serving helper ``_env_num(name, ...)``, and
+``RetryPolicy.from_env(prefix)`` — the latter expands to the three
+``<PREFIX>_MAX_ATTEMPTS`` / ``<PREFIX>_BASE_DELAY`` /
+``<PREFIX>_MAX_DELAY`` knobs it actually reads. Doc tokens ending in
+``*`` match as prefixes, so one wildcard row can cover a family.
+
+Mentions in comments/docstrings do NOT count as reads (only AST call /
+subscript sites do), and only string literals are checked — a
+dynamically built name is the caller's responsibility.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding
+from .common import dotted_parts
+
+RULE = "env-var-drift"
+
+_ENV_NAME = re.compile(r"^MXNET_[A-Z0-9_]+$")
+_DOC_TOKEN = re.compile(r"MXNET_[A-Z0-9_]+\*?")
+_FROM_ENV_SUFFIXES = ("_MAX_ATTEMPTS", "_BASE_DELAY", "_MAX_DELAY")
+
+
+def _literal_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+def _env_reads(tree):
+    """Yield (env_name, node) for every recognized read site."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript):
+            parts = dotted_parts(node.value)
+            if parts[-1:] == ["environ"]:
+                name = _literal_str(node.slice)
+                if name:
+                    yield name, node
+        elif isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if not parts:
+                continue
+            tail = parts[-1]
+            name = _literal_str(node.args[0]) if node.args else None
+            if name is None:
+                continue
+            if tail in ("get", "setdefault") \
+                    and parts[-2:-1] == ["environ"]:
+                yield name, node
+            elif tail == "getenv" and parts[:1] == ["os"]:
+                yield name, node
+            elif tail == "_env_num":
+                yield name, node
+            elif tail == "from_env" and "RetryPolicy" in parts:
+                # only RetryPolicy.from_env has the *_MAX_ATTEMPTS /
+                # *_BASE_DELAY / *_MAX_DELAY expansion; an unrelated
+                # from_env classmethod must not create phantom rows
+                for suffix in _FROM_ENV_SUFFIXES:
+                    yield name + suffix, node
+
+
+def _documented(doc_path):
+    """(exact names, prefix names) found in TABLE ROWS of the doc. A
+    prose mention ("no analog: MXNET_FOO") is deliberately not a row —
+    the gate's contract is a row with default + meaning, and prose must
+    not be able to satisfy it."""
+    try:
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return set(), set()
+    exact, prefixes = set(), set()
+    for line in lines:
+        if not line.lstrip().startswith("|"):
+            continue
+        for tok in _DOC_TOKEN.findall(line):
+            if tok.endswith("*"):
+                prefixes.add(tok[:-1])
+            else:
+                exact.add(tok)
+    return exact, prefixes
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        exact, prefixes = _documented(project.env_doc)
+        doc_rel = os.path.relpath(project.env_doc,
+                                  project.root).replace(os.sep, "/")
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            seen = set()   # one finding per (file, var)
+            for name, node in _env_reads(mod.tree):
+                if not _ENV_NAME.match(name) or name in seen:
+                    continue
+                seen.add(name)
+                if name in exact or any(name.startswith(p)
+                                        for p in prefixes):
+                    continue
+                findings.append(Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    "env var %s is read here but has no row in %s"
+                    % (name, doc_rel),
+                    hint="add a row (variable, default, meaning) to "
+                         "the doc, or rename the knob"))
+        return findings
+
+
+PASS = Pass()

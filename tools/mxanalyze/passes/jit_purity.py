@@ -1,0 +1,138 @@
+"""jit-purity: Python side effects lexically inside a traced function.
+
+``jax.jit`` (and ``tracked_jit``, ``pl.pallas_call``, ``jax.checkpoint``)
+executes the Python body ONCE per signature, at trace time. Any side
+effect in that body — a clock read, an env-var read, a telemetry bump, a
+log line, stdlib randomness, mutation of enclosing state — silently
+bakes its trace-time value into the compiled program or fires once
+instead of per step. This is the discipline JAX's omnistaging enforces
+dynamically (by erroring on some of it) moved to a lexical check.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import (dotted_parts, import_aliases, jit_index,
+                     local_bindings)
+
+RULE = "jit-purity"
+
+#: dotted prefixes (after alias resolution) that are side effects /
+#: trace-time-only values. ``jax.random`` is pure and never matches —
+#: alias resolution turns ``from jax import random`` into "jax.random".
+_DENY = (
+    ("time.", "the clock is read once, at trace time"),
+    ("datetime.", "the clock is read once, at trace time"),
+    ("random.", "stdlib randomness is drawn once at trace time (use "
+                "jax.random with an explicit key)"),
+    ("numpy.random.", "numpy randomness is drawn once at trace time "
+                      "(use jax.random)"),
+    ("np.random.", "numpy randomness is drawn once at trace time "
+                   "(use jax.random)"),
+    ("os.environ", "the environment is read once, at trace time"),
+    ("os.getenv", "the environment is read once, at trace time"),
+    ("os.putenv", "the environment is read once, at trace time"),
+    ("logging.", "logs fire once per compile, not once per step"),
+    ("warnings.", "warnings fire once per compile, not once per step"),
+    ("logger.", "logs fire once per compile, not once per step"),
+    ("log.", "logs fire once per compile, not once per step"),
+    ("telemetry.", "registry mutations run at trace time, not per step"),
+    ("mxnet_tpu.telemetry.",
+     "registry mutations run at trace time, not per step"),
+)
+
+#: bare builtins that are I/O at trace time.
+_DENY_BUILTINS = {"print", "open", "input"}
+
+
+def _deny_reason(parts, aliases):
+    target = aliases.get(parts[0])
+    if target:
+        parts = target.split(".") + parts[1:]
+    full = ".".join(parts)
+    for prefix, why in _DENY:
+        if full.startswith(prefix):
+            return why
+    if len(parts) == 1 and parts[0] in _DENY_BUILTINS:
+        return "I/O executes at trace time only"
+    return None
+
+
+def _fn_label(fn):
+    return getattr(fn, "name", "<lambda>")
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            index = jit_index(mod)
+            aliases = import_aliases(mod.tree)
+            for fn in index.jitted_defs:
+                findings.extend(self._check_fn(mod, fn, aliases))
+        return findings
+
+    def _check_fn(self, mod, fn, aliases):
+        out = []
+        label = _fn_label(fn)
+        locals_ = local_bindings(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    parts = dotted_parts(node.func)
+                    if not parts:
+                        continue
+                    why = _deny_reason(parts, aliases)
+                    if why:
+                        out.append(Finding(
+                            RULE, mod.relpath, node.lineno,
+                            node.col_offset,
+                            "call to %s() inside jit-wrapped '%s': %s"
+                            % (".".join(parts), label, why),
+                            hint="hoist it out of the traced function "
+                                 "or pass the value in as an argument"))
+                elif isinstance(node, ast.Global):
+                    out.append(Finding(
+                        RULE, mod.relpath, node.lineno, node.col_offset,
+                        "`global %s` inside jit-wrapped '%s': the "
+                        "mutation happens at trace time only"
+                        % (", ".join(node.names), label),
+                        hint="thread the value through the function's "
+                             "arguments and return value"))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in tgts:
+                        root = _subscript_attr_root(tgt)
+                        if root and root not in locals_ \
+                                and root != "self":
+                            out.append(Finding(
+                                RULE, mod.relpath, node.lineno,
+                                node.col_offset,
+                                "jit-wrapped '%s' mutates enclosing-"
+                                "scope state '%s': the write happens at "
+                                "trace time only" % (label, root),
+                                hint="return the new value instead of "
+                                     "mutating closed-over state"))
+        return out
+
+
+def _subscript_attr_root(tgt):
+    """Root Name of an attribute/subscript write target (``cache[k]``,
+    ``obj.field``); None for plain-name targets (local rebinding is
+    fine)."""
+    node = tgt
+    if not isinstance(node, (ast.Subscript, ast.Attribute)):
+        return None
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+PASS = Pass()

@@ -1,0 +1,307 @@
+"""lock-discipline: mixed-guard writes, acquisition-order cycles,
+non-reentrant self-nesting.
+
+Builds a project-wide lock table (module-level ``X = threading.Lock()``
+/ ``RLock`` / ``Condition`` / ``Semaphore``, and ``self.X = ...`` in
+methods), then walks every function with a lexical with-lock stack:
+
+- **mixed-guard**: a symbol (``self.attr`` keyed by class, or a
+  module-level global / its subscripts) written at least once under a
+  recognized lock AND at least once outside any lock — the unguarded
+  write sites are flagged. ``__init__``/``__new__`` bodies are exempt
+  (construction is single-threaded by convention), as is module top
+  level (import lock).
+- **order**: acquiring lock B while holding lock A records edge A->B;
+  a pair with edges both ways across the project is an inversion
+  (deadlock when the two paths interleave).
+- **reentry**: ``with`` on a lock already on the stack when the lock
+  was created by ``threading.Lock()`` (non-reentrant: self-deadlock).
+
+Mutating method calls (``.append``/``.clear``/``.update``/...) on a
+tracked symbol count as writes.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import dotted_parts, import_aliases, module_globals
+
+RULE = "lock-discipline"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATORS = {"append", "extend", "clear", "update", "pop", "popitem",
+             "setdefault", "remove", "discard", "add", "insert"}
+_EXEMPT_FNS = {"__init__", "__new__", "__init_subclass__"}
+
+
+def _lock_ctor_kind(call, aliases):
+    """'Lock'/'RLock'/... when ``call`` constructs a threading primitive
+    (``threading.Lock()``, an aliased module, or a bare ``Lock()`` from
+    ``from threading import Lock``), else None."""
+    parts = dotted_parts(call.func) if isinstance(call, ast.Call) else []
+    if not parts or parts[-1] not in _LOCK_CTORS:
+        return None
+    if len(parts) >= 2:
+        base = parts[-2]
+        if base != "threading" and aliases.get(base) != "threading":
+            return None
+    return parts[-1]
+
+
+class _LockTable:
+    """lock id -> ctor kind. Ids are keyed by the module's RELPATH
+    (stems collide — the repo has several ``engine.py``/``io.py``):
+    ("mod", <relpath>, <name>)  module-level lock
+    ("cls", <relpath>, <Class>, <attr>)  instance lock
+    ``by_stem`` maps a module stem to the relpaths holding locks, for
+    cross-module ``with telemetry._lock`` resolution (skipped when the
+    stem is ambiguous).
+    """
+
+    def __init__(self):
+        self.kinds = {}
+        self.by_stem = {}
+
+    def collect(self, mod):
+        if mod.tree is None:
+            return
+        aliases = import_aliases(mod.tree)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value, aliases)
+                if kind:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.kinds[("mod", mod.relpath,
+                                        tgt.id)] = kind
+                            self.by_stem.setdefault(mod.stem,
+                                                    set()).add(mod.relpath)
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        kind = _lock_ctor_kind(sub.value, aliases)
+                        if not kind:
+                            continue
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Attribute) \
+                                    and isinstance(tgt.value, ast.Name) \
+                                    and tgt.value.id == "self":
+                                self.kinds[("cls", mod.relpath,
+                                            node.name,
+                                            tgt.attr)] = kind
+
+    def resolve(self, mod, aliases, class_name, expr):
+        """Lock id for a with-item expression, or None."""
+        if isinstance(expr, ast.Name):
+            lid = ("mod", mod.relpath, expr.id)
+            return lid if lid in self.kinds else None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and class_name:
+                lid = ("cls", mod.relpath, class_name, attr)
+                if lid in self.kinds:
+                    return lid
+                return None
+            tail = aliases.get(base)
+            if tail:
+                owners = [rp for rp in self.by_stem.get(
+                    tail.split(".")[-1], ())
+                    if ("mod", rp, attr) in self.kinds]
+                if len(owners) == 1:   # ambiguous stems: no resolution
+                    return ("mod", owners[0], attr)
+        return None
+
+
+def _lock_label(lid):
+    stem = lid[1].rsplit("/", 1)[-1].rsplit(".", 1)[0]
+    if lid[0] == "mod":
+        return "%s.%s" % (stem, lid[2])
+    return "%s.%s.self.%s" % (stem, lid[2], lid[3])
+
+
+def _write_targets(node):
+    """(target_expr, is_write) pairs for assignments and mutator
+    calls."""
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target]
+    if isinstance(node, ast.Call) and isinstance(node.func,
+                                                 ast.Attribute) \
+            and node.func.attr in _MUTATORS:
+        return [node.func.value]
+    return []
+
+
+def _symbol_of(expr, globals_, class_name):
+    """Tracked symbol for a write target: peel subscripts, then match
+    ``self.attr`` (class symbol) or a module-global name."""
+    node = expr
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self" and class_name:
+        return ("attr", class_name, node.attr)
+    if isinstance(node, ast.Name) and node.id in globals_:
+        return ("global", node.id)
+    return None
+
+
+class _FnWalker(ast.NodeVisitor):
+    """Walk one function body with a with-lock stack."""
+
+    def __init__(self, pass_, mod, aliases, class_name, fn):
+        self.p = pass_
+        self.mod = mod
+        self.aliases = aliases
+        self.class_name = class_name
+        self.fn = fn
+        self.stack = []
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            lid = self.p.table.resolve(self.mod, self.aliases,
+                                       self.class_name,
+                                       item.context_expr)
+            if lid is None:
+                continue
+            for held in self.stack:
+                if held == lid:
+                    if self.p.table.kinds.get(lid) == "Lock":
+                        self.p.findings.append(Finding(
+                            RULE, self.mod.relpath, node.lineno,
+                            node.col_offset,
+                            "nested acquisition of non-reentrant lock "
+                            "%s: self-deadlock" % _lock_label(lid),
+                            hint="use threading.RLock or restructure"))
+                else:
+                    self.p.edges.setdefault(
+                        (held, lid), []).append(
+                            (self.mod.relpath, node.lineno))
+            acquired.append(lid)
+            self.stack.append(lid)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested defs are walked as their own functions: a def
+            # CREATED under a lock does not RUN under it
+            return
+        for tgt in _write_targets(node):
+            sym = _symbol_of(tgt,
+                             self.p.globals_by_mod[self.mod.relpath],
+                             self.class_name)
+            if sym is not None and self.fn.name not in _EXEMPT_FNS:
+                key = (self.mod.relpath,) + sym
+                self.p.writes.setdefault(key, []).append(
+                    (self.mod.relpath, node.lineno, node.col_offset,
+                     tuple(self.stack)))
+        # do not descend into nested defs here; they are walked as their
+        # own functions (the lock stack is runtime state, but a nested
+        # def defined under a lock does NOT run under it)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            self.visit(child)
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        self.table = _LockTable()
+        self.findings = []
+        self.edges = {}      # (outer, inner) -> [(path, line)]
+        self.writes = {}     # symbol key -> [(path, line, col, locks)]
+        self.globals_by_mod = {}
+        for mod in project.modules:
+            self.table.collect(mod)
+            if mod.tree is not None:
+                self.globals_by_mod[mod.relpath] = \
+                    module_globals(mod.tree)
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            aliases = import_aliases(mod.tree)
+            self._walk_module(mod, aliases)
+        self._report_mixed()
+        self._report_inversions()
+        return self.findings
+
+    def _walk_module(self, mod, aliases):
+        def walk_body(nodes, class_name):
+            for node in nodes:
+                if isinstance(node, ast.ClassDef):
+                    walk_body(node.body, node.name)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    w = _FnWalker(self, mod, aliases, class_name, node)
+                    for stmt in node.body:
+                        w.visit(stmt)
+                    # nested defs run with their own (empty) stack
+                    for sub in ast.walk(node):
+                        if sub is not node and isinstance(
+                                sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                            wn = _FnWalker(self, mod, aliases,
+                                           class_name, sub)
+                            for stmt in sub.body:
+                                wn.visit(stmt)
+
+        walk_body(mod.tree.body, None)
+
+    def _report_mixed(self):
+        for key, sites in sorted(self.writes.items()):
+            locked = [s for s in sites if s[3]]
+            unlocked = [s for s in sites if not s[3]]
+            if not locked or not unlocked:
+                continue
+            lock_names = sorted({_lock_label(l) for s in locked
+                                 for l in s[3]})
+            sym = key[1:]
+            label = ("%s.%s" % (sym[1], sym[2]) if sym[0] == "attr"
+                     else sym[1])
+            for path, line, col, _ in unlocked:
+                # the example guarded site goes in the HINT: messages are
+                # baseline fingerprints and must stay line-independent
+                self.findings.append(Finding(
+                    RULE, path, line, col,
+                    "'%s' is written under %s elsewhere but written "
+                    "without the lock here"
+                    % (label, "/".join(lock_names)),
+                    hint="take the lock (guarded write at %s:%d), or "
+                         "document why this site is single-threaded "
+                         "and allow() it"
+                         % (locked[0][0], locked[0][1])))
+
+    def _report_inversions(self):
+        seen = set()
+        for (a, b), sites in sorted(self.edges.items()):
+            if (b, a) not in self.edges or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            other = self.edges[(b, a)]
+            path, line = sites[0]
+            self.findings.append(Finding(
+                RULE, path, line, 0,
+                "lock order inversion: %s -> %s here but %s -> %s "
+                "elsewhere — concurrent paths can deadlock"
+                % (_lock_label(a), _lock_label(b), _lock_label(b),
+                   _lock_label(a)),
+                hint="pick one global order and document it in the "
+                     "module docstring (opposite order at %s:%d)"
+                     % (other[0][0], other[0][1])))
+
+
+PASS = Pass()

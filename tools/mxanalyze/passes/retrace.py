@@ -1,0 +1,257 @@
+"""retrace-hazard: lexical patterns that unbound the XLA signature set.
+
+Complements the runtime retrace EXPLAINER in ``xla_stats.py`` (which
+names the changed dimension AFTER a retrace happened) with the checks
+that prevent the hazard from landing:
+
+1. ``static_argnums``/``static_argnames`` built dynamically (not an int
+   literal / literal tuple of ints) — the static set silently varies
+   between wrap sites, so signatures multiply.
+2. an unhashable literal (list/dict/set) passed at a call site in a
+   position the same-module jit wrap declared static — hash() raises at
+   dispatch, or worse, a tuple-ified copy compiles per value.
+3. a Python scalar derived from ``.shape`` / ``len()`` passed as a
+   TRACED argument to a known-jitted callable — shape-like values want
+   to be static (or re-derived inside the trace); traced, they turn a
+   shape change into a silent weak-typed constant or a per-call device
+   transfer.
+4. raw (unbucketed) batch shapes reaching the serving engine: in
+   ``mxnet_tpu/serving/`` (outside ``batching.py``'s ladder) a row
+   count derived from request data (``.n`` / ``len()`` / ``.shape`` /
+   ``sum()``) must flow through ``pick_bucket`` before it shapes an
+   array, or the bounded-signature guarantee (warm-compiled buckets,
+   ``cold_compiles() == 0``) silently breaks.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import (dotted_parts, dotted_str, jit_index,
+                     static_positions)
+
+RULE = "retrace-hazard"
+
+_SHAPE_FNS = {"len"}
+_NP_SHAPE_CTORS = {"zeros", "ones", "empty", "full"}
+
+
+def _is_shape_read(node):
+    """``x.shape`` or ``x.shape[i]``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    return isinstance(node, ast.Attribute) and node.attr == "shape"
+
+
+class _Taint:
+    """Forward single-pass taint over one function body."""
+
+    def __init__(self, sources_attrs=(), sanitizers=("pick_bucket",)):
+        self.tainted = set()
+        self.sources_attrs = set(sources_attrs)   # attr names like "n"
+        self.sanitizers = set(sanitizers)
+
+    def expr_tainted(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if _is_shape_read(node):
+            return True
+        if isinstance(node, ast.Attribute):
+            return node.attr in self.sources_attrs
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if parts and parts[-1] in self.sanitizers:
+                return False
+            if parts and parts[-1] in _SHAPE_FNS | {"sum"} \
+                    and ("sum" in self.sources_attrs or
+                         parts[-1] in _SHAPE_FNS):
+                return True
+            if parts == ["int"] and node.args:
+                return self.expr_tainted(node.args[0])
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.expr_tainted(node.left) \
+                or self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        return False
+
+    def note_assign(self, node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if self.expr_tainted(node.value):
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            if self.expr_tainted(node.value):
+                self.tainted.add(node.target.id)
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+_OWN_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _stmts_in_order(body):
+    """Statements in source/execution order, recursing into compound
+    bodies but NOT into nested defs/classes (their own scope — they are
+    analyzed as their own functions)."""
+    for node in body:
+        if isinstance(node, ast.ExceptHandler):
+            yield from _stmts_in_order(node.body)
+            continue
+        if isinstance(node, _OWN_SCOPE):
+            continue
+        yield node
+        for _field, value in ast.iter_fields(node):
+            if isinstance(value, list) and value and isinstance(
+                    value[0], (ast.stmt, ast.ExceptHandler)):
+                yield from _stmts_in_order(value)
+
+
+def _expr_walk(stmt):
+    """Expression nodes of one statement, pruning child statements
+    (yielded separately by ``_stmts_in_order``) and nested scopes."""
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt, ast.ExceptHandler, ast.Lambda)) \
+                or isinstance(child, _OWN_SCOPE):
+            continue
+        yield child
+        yield from _expr_walk(child)
+
+
+def _ordered_exprs(fn, taint):
+    """Single forward pass: yield each statement's expression nodes for
+    sink checks, THEN fold its assignment into the taint state — a later
+    rebinding can neither taint nor sanitize an earlier call site."""
+    for stmt in _stmts_in_order(fn.body):
+        yield from _expr_walk(stmt)
+        taint.note_assign(stmt)
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            index = jit_index(mod)
+            findings.extend(self._check_static_argnums(mod, index))
+            findings.extend(self._check_call_sites(mod, index))
+            if mod.relpath.startswith("mxnet_tpu/serving/") \
+                    and mod.stem != "batching":
+                findings.extend(self._check_serving(mod))
+        return findings
+
+    # (1) dynamically-constructed static_argnums / names
+    def _check_static_argnums(self, mod, index):
+        out = []
+        for call in index.wrap_calls:
+            for kw in call.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                if kw.arg == "static_argnames":
+                    ok = isinstance(kw.value, ast.Constant) or (
+                        isinstance(kw.value, (ast.Tuple, ast.List))
+                        and all(isinstance(e, ast.Constant)
+                                for e in kw.value.elts))
+                else:
+                    _, dyn = static_positions(call)
+                    ok = dyn is None
+                if not ok:
+                    out.append(Finding(
+                        RULE, mod.relpath, kw.value.lineno,
+                        kw.value.col_offset,
+                        "%s is not a literal (dynamically constructed "
+                        "static set): every construction variant is a "
+                        "distinct jit signature" % kw.arg,
+                        hint="spell the static positions as an int/"
+                             "tuple literal at the wrap site"))
+        return out
+
+    # (2) unhashable static values + (3) shape-derived traced scalars
+    def _check_call_sites(self, mod, index):
+        out = []
+        if not index.jitted_names:
+            return out
+        for fn in _functions(mod.tree):
+            taint = _Taint()
+            for node in _ordered_exprs(fn, taint):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_str(node.func)
+                if name not in index.jitted_names:
+                    continue
+                static = index.jitted_names[name]
+                for i, arg in enumerate(node.args):
+                    is_static = static is not None and i in static
+                    if is_static and isinstance(
+                            arg, (ast.List, ast.Dict, ast.Set,
+                                  ast.ListComp, ast.DictComp,
+                                  ast.SetComp)):
+                        out.append(Finding(
+                            RULE, mod.relpath, arg.lineno,
+                            arg.col_offset,
+                            "unhashable %s passed for static arg %d of "
+                            "jitted '%s': static args must hash to hit "
+                            "the jit cache"
+                            % (type(arg).__name__.lower(), i, name),
+                            hint="pass a tuple / frozen value"))
+                    elif not is_static and taint.expr_tainted(arg):
+                        out.append(Finding(
+                            RULE, mod.relpath, arg.lineno,
+                            arg.col_offset,
+                            "Python scalar derived from .shape/len() "
+                            "passed as traced arg %d of jitted '%s'"
+                            % (i, name),
+                            hint="mark the position static, or derive "
+                                 "the value inside the traced function"))
+        return out
+
+    # (4) unbucketed batch shapes in serving code
+    def _check_serving(self, mod):
+        out = []
+        for fn in _functions(mod.tree):
+            taint = _Taint(sources_attrs={"n", "sum"})
+            for node in _ordered_exprs(fn, taint):
+                sink = self._serving_sink(node, taint)
+                if sink is not None:
+                    out.append(Finding(
+                        RULE, mod.relpath, sink.lineno, sink.col_offset,
+                        "request-derived row count shapes an array "
+                        "outside the bucket ladder: signatures become "
+                        "unbounded and steady-state serving recompiles",
+                        hint="route the count through "
+                             "batching.pick_bucket() first"))
+        return out
+
+    @staticmethod
+    def _serving_sink(node, taint):
+        # (n,) + shape  — shape-tuple construction with tainted head
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add) \
+                and isinstance(node.left, ast.Tuple) and node.left.elts:
+            if taint.expr_tainted(node.left.elts[0]):
+                return node.left.elts[0]
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if parts and parts[-1] == "pad_rows" and len(node.args) >= 2 \
+                    and taint.expr_tainted(node.args[1]):
+                return node.args[1]
+            if parts and parts[-1] in _NP_SHAPE_CTORS and node.args \
+                    and isinstance(node.args[0], ast.Tuple) \
+                    and node.args[0].elts \
+                    and taint.expr_tainted(node.args[0].elts[0]):
+                return node.args[0].elts[0]
+        return None
+
+
+PASS = Pass()

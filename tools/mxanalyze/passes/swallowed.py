@@ -1,0 +1,84 @@
+"""swallowed-exception: broad handlers that hide failures.
+
+Flags ``except Exception`` / ``except BaseException`` / bare ``except``
+handlers whose body neither re-raises, logs (``logging``/``logger``/
+``warnings``/``traceback``), nor bumps telemetry (any ``telemetry.*``
+call — ``telemetry.swallowed(site, exc)`` is the one-line idiom).
+Narrow handlers (``except OSError``) are out of scope: catching a named
+failure mode silently is a choice the narrow type documents; catching
+EVERYTHING silently is how real bugs disappear.
+
+Deliberate swallows (exit paths, "never break the caller" guards) get
+``# mxanalyze: allow(swallowed-exception): <reason>`` on the ``except``
+line.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import dotted_parts
+
+RULE = "swallowed-exception"
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_ROOTS = {"logging", "logger", "warnings", "traceback", "telemetry",
+              "log"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "print_exc", "log", "swallowed"}
+
+
+def _is_broad(handler):
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        for e in t.elts:
+            parts = dotted_parts(e)
+            if parts:
+                names.append(parts[-1])
+    else:
+        parts = dotted_parts(t)
+        if parts:
+            names.append(parts[-1])
+    return any(n in _BROAD for n in names)
+
+
+def _observes(handler):
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if not parts:
+                continue
+            if parts[0] in _LOG_ROOTS or parts[-1] in _LOG_METHODS:
+                return True
+    return False
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node) or _observes(node):
+                    continue
+                findings.append(Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    "broad except swallows the failure without logging "
+                    "or counting it",
+                    hint="log at debug, call telemetry.swallowed("
+                         "site, exc), or annotate `# mxanalyze: "
+                         "allow(swallowed-exception): <reason>`"))
+        return findings
+
+
+PASS = Pass()

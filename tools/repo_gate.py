@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Combined repo gate: static analysis + (optional) benchmark regression.
+
+Runs the two gates that share exit-code conventions (0 = pass,
+1 = regression) and BENCH-style one-line JSON summaries:
+
+- ``tools/mxanalyze --strict`` over ``mxnet_tpu/`` against the checked-in
+  ``tools/mxanalyze/baseline.json`` — a NEW finding of any rule
+  (jit-purity, retrace-hazard, lock-discipline, swallowed-exception,
+  env-var-drift) fails the gate the same way a perf regression does;
+- ``tools/bench_gate.py`` over a bench run file, when one is given.
+
+Usage:
+    python tools/repo_gate.py                     # analysis only
+    python tools/repo_gate.py --bench run.jsonl   # analysis + perf
+    python bench.py | python tools/repo_gate.py --bench -
+
+Exit status: 0 when every gate passed, 1 when any failed.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench", default=None, metavar="RUN",
+                    help="bench output (JSON lines; '-' = stdin) to gate "
+                         "via tools/bench_gate.py")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="bench_gate regression threshold override")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="paths for mxanalyze (default: mxnet_tpu/)")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, REPO)
+    from tools.mxanalyze.cli import main as mxanalyze_main
+
+    mx_args = ["--strict"] + (args.paths or [])
+    rc = mxanalyze_main(mx_args)
+
+    if args.bench is not None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_gate
+        bench_args = [args.bench]
+        if args.threshold is not None:
+            bench_args += ["--threshold", str(args.threshold)]
+        rc = max(rc, bench_gate.main(bench_args))
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
