@@ -48,12 +48,28 @@ def bench_train():
     can't skew the inference measurement above).  Any failure degrades to
     a stderr note; the inference line already printed.
     """
+    # a previous round's anatomy must never masquerade as this run's:
+    # drop the stale file up front, rewrite it only on a run that
+    # actually produced a phase breakdown
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_stepprof.json")
+    if os.path.exists(path):
+        os.remove(path)
     try:
         rec = tools_import("bench_all").bench_resnet50_train()
     except Exception as e:
         sys.stderr.write("train benchmark failed: %r\n" % (e,))
         return
     emit(rec)
+    if rec.get("phases"):
+        # leave the anatomy where `python -m mxnet_tpu.stepprof report`
+        # finds it with no arguments (next to bench_telemetry.prom)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"metric": "train_phase_breakdown",
+                       "phases": rec["phases"],
+                       "verdict": rec.get("verdict"),
+                       "source_metric": rec["metric"],
+                       "updated": time.time()}, fh)
 
 
 def tools_import(name):

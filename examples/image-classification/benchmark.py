@@ -167,6 +167,15 @@ def main():
         jax.profiler.stop_trace()
         print("trace captured in %s; run: python -m mxnet_tpu.xplane %s "
               "--line 'XLA Ops'" % (args.profile, args.profile))
+
+    # -- step-time anatomy attribution pass (mxnet_tpu.stepprof) --------
+    # Runs AFTER the timed rounds so the headline rate stays
+    # uninstrumented: every step here forces a device sync
+    # (sync_every=1) so device_compute is a measured wall tile, and the
+    # K-batch superbatch is re-staged per step so h2d is visible. Emits
+    # one JSON line bench_all.py attaches to the TRAIN metric record.
+    _bench_phase_breakdown(args, mod, batches, att_calls=2)
+
     # MFU: fwd MACs x2 (flops per MAC) x3 (fwd + bwd costs ~2x fwd; the
     # optimizer is O(params), noise). The commonly-quoted "4.09 GFLOPs"
     # for ResNet-50 is actually GMACs (torchvision convention) — true
@@ -185,6 +194,46 @@ def main():
           "x %d calls%s)"
           % (args.model, args.dtype, batch, rate, len(rates),
              sum(rates) / len(rates), compile_s, K, calls, mfu))
+
+
+def _bench_phase_breakdown(args, mod, batches, att_calls=2):
+    """Short instrumented pass: p50 phase shares + bottleneck verdict as
+    one JSON line (`bench_all.py` folds it into the TRAIN record so the
+    BENCH history carries attribution)."""
+    import json
+    import numpy as np
+    from mxnet_tpu import stepprof, telemetry
+
+    K = args.batches_per_dispatch
+    stepprof.enable(sync_every=1)
+    stepprof.reset()
+    for _ in range(max(1, att_calls)):
+        with stepprof.step(batches=K):
+            if K > 1:
+                mod._step_scan(batches)
+            else:
+                mod._step(batches[0])
+            # the sampled block_until_ready above can be a fast-path
+            # no-op on relayed PJRT backends (see the sync discipline
+            # note in main); a host readback of an output is the one
+            # barrier that provably waits, so bracket it as
+            # device_compute INSIDE the step — without it the device
+            # time would leak out of the record and the verdict would
+            # call a compute-bound run dispatch-bound
+            with stepprof.phase("device_compute", via="readback"):
+                float(np.asarray(
+                    mod.get_outputs()[0].asnumpy()).ravel()[0])
+    shares = stepprof.shares(basis="p50")
+    retr = telemetry.get_metric("jit_retraces_total")
+    verdict, hint = stepprof.classify(
+        shares, retraces=retr.value if retr else 0,
+        fused=mod._fused_plan is not False,
+        donated=bool(getattr(mod, "scan_donate_params", False)))
+    print(json.dumps({
+        "metric": "train_phase_breakdown", "unit": "share",
+        "phases": {k: round(v, 4) for k, v in shares.items()},
+        "verdict": verdict, "hint": hint}), flush=True)
+    stepprof.write_host_snapshot(force=True)  # telemetry dir, if armed
 
 
 if __name__ == "__main__":

@@ -93,6 +93,8 @@ class Speedometer:
         self.tic = time.time()
         self._samples_tic = self._registry_samples()
         self._batches_tic = self._registry_batches()
+        from . import stepprof
+        self._phase_tic = stepprof.totals()
 
     def _speed(self):
         elapsed = time.time() - self.tic
@@ -116,6 +118,31 @@ class Speedometer:
         return "\tmfu: %.2f%% (%.3e model FLOP/s)" % (
             g["mfu"] * 100.0, g["model_flops_per_second"])
 
+    #: short display labels for the step-anatomy phase summary
+    _PHASE_LABELS = (("data_wait", "data"), ("h2d", "h2d"),
+                     ("dispatch", "disp"), ("device_compute", "compute"),
+                     ("sync", "sync"), ("opt_update", "opt"),
+                     ("other", "other"))
+
+    def _phase_suffix(self):
+        """One-line step-time anatomy for the window since the last
+        mark, e.g. ``\\tdata 4% | compute 78% | sync 11%`` — gated by
+        MXNET_STEPPROF (`stepprof.enabled()`); "" when disabled or no
+        phase advanced. Phases under 1% of the window are elided."""
+        from . import stepprof
+        if not stepprof.enabled():
+            return ""
+        cur = stepprof.totals()
+        prev = getattr(self, "_phase_tic", {})
+        delta = {k: cur.get(k, 0.0) - prev.get(k, 0.0) for k in cur}
+        total = sum(d for d in delta.values() if d > 0)
+        if total <= 0:
+            return ""
+        parts = ["%s %.0f%%" % (label, 100.0 * delta.get(name, 0.0) / total)
+                 for name, label in self._PHASE_LABELS
+                 if delta.get(name, 0.0) / total >= 0.01]
+        return "\t" + " | ".join(parts) if parts else ""
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -126,19 +153,20 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = self._speed()
                 goodput = self._goodput_suffix()
+                phases = self._phase_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += goodput.replace("%", "%%")
+                    msg += (goodput + phases).replace("%", "%%")
                     msg += "\t%s=%f" * len(name_value)
                     logging.info(msg, param.epoch, count, speed,
                                  *sum(name_value, ()))
                 else:
                     logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                        param.epoch, count, speed, goodput)
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s%s",
+                        param.epoch, count, speed, goodput, phases)
                 self._mark()
         else:
             self.init = True

@@ -287,7 +287,15 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread double-buffered prefetch (reference iter_prefetcher.h
-    / io.py PrefetchingIter)."""
+    / io.py PrefetchingIter).
+
+    Telemetry (ROADMAP item 4: input-boundness must show up in the same
+    dashboards as MFU): ``prefetch_queue_depth`` gauge (scrape-time
+    sample of the ready-batch queue; the LAST constructed iterator owns
+    the gauge) and ``prefetch_wait_seconds{side=}`` histograms —
+    ``side="consumer"`` is time the training loop blocked waiting for a
+    batch (producer too slow: input-bound), ``side="producer"`` is time
+    the producer blocked on a full queue (consumer too slow: healthy)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
         super().__init__()
@@ -305,6 +313,26 @@ class PrefetchingIter(DataIter):
         self._thread = None
         self._error = None
         self.current_batch = None
+        from . import telemetry
+        self._wait_producer = telemetry.histogram(
+            "prefetch_wait_seconds",
+            help="prefetch waits: consumer=training loop starved, "
+                 "producer=queue full (healthy)", side="producer")
+        self._wait_consumer = telemetry.histogram(
+            "prefetch_wait_seconds", side="consumer")
+        # weakref: the registry must not keep a dropped iterator (and its
+        # producer thread's queue) alive through the gauge closure
+        import weakref
+        ref = weakref.ref(self)
+
+        def _depth_now():
+            it = ref()
+            return None if it is None else it._queue.qsize()
+        telemetry.gauge(
+            "prefetch_queue_depth",
+            help="ready batches in the prefetch queue (sampled at "
+                 "scrape; last-constructed PrefetchingIter reports)"
+        ).set_function(_depth_now)
         self._start()
 
     @property
@@ -325,14 +353,21 @@ class PrefetchingIter(DataIter):
         """Stop-aware put: a producer blocked on a full queue re-checks
         ``_stop`` every 50 ms, so ``reset()`` can always shake it loose —
         a plain blocking ``put`` could outlive the 5 s join and keep
-        feeding the discarded queue forever. Returns False on stop."""
-        while not self._stop.is_set():
-            try:
-                queue.put(item, timeout=0.05)
-                return True
-            except _queue.Full:
-                continue
-        return False
+        feeding the discarded queue forever. Returns False on stop.
+
+        Time spent blocked on a full queue is observed into
+        ``prefetch_wait_seconds{side="producer"}``."""
+        t0 = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                try:
+                    queue.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+        finally:
+            self._wait_producer.observe(time.monotonic() - t0)
 
     def _producer(self):
         queue = self._queue
@@ -340,6 +375,7 @@ class PrefetchingIter(DataIter):
             for batch in self.iters[0]:
                 if not self._put(queue, batch):
                     return
+        # mxanalyze: allow(swallowed-exception): deferred, not swallowed — stored and re-raised on the consumer thread in iter_next()
         except Exception as exc:   # noqa: BLE001 - re-raised on consumer
             # a mid-epoch crash of the wrapped iterator must surface in
             # iter_next(), NOT masquerade as a clean end-of-epoch (silent
@@ -387,7 +423,9 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def iter_next(self):
+        t0 = time.monotonic()
         batch = self._queue.get()
+        self._wait_consumer.observe(time.monotonic() - t0)
         if batch is None:
             if self._error is not None:
                 err, self._error = self._error, None

@@ -15,6 +15,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import io as io_mod
+from .. import stepprof
 from .. import telemetry
 from ..ndarray import NDArray
 
@@ -32,7 +33,8 @@ def _count_fit_batch(batch):
     samples/sec from these counters instead of recomputing locally."""
     try:
         samples = int(batch.data[0].shape[0])
-    except Exception:
+    except Exception as exc:  # exotic batch payloads still count batches
+        telemetry.swallowed("fit.count_batch", exc)
         samples = 0
     telemetry.counter("fit_batches_total",
                       help="train batches completed by Module.fit").inc()
@@ -270,79 +272,94 @@ class BaseModule:
             data_iter = iter(train_data)
             end_of_batch = False
             next_data_batch = next(data_iter)
+            # every loop iteration is one stepprof step; the taxonomy
+            # phases inside come from _step/_step_scan/update (h2d,
+            # dispatch, device_compute, sync, opt_update) plus the two
+            # loop-level phases here: data_wait (iterator blocked) and
+            # device_compute via the metric readback — reading outputs
+            # to host is where the step's async device work is actually
+            # awaited, so that wait is device time, not "sync"
             while not end_of_batch:
                 if use_scan:
                     # gather up to K batches, run them in one dispatch
                     group = [next_data_batch]
-                    with telemetry.span("fit.data", phase="scan_gather"):
-                        while len(group) < batches_per_dispatch:
-                            try:
-                                nb = next(data_iter)
-                                self.prepare(nb,
-                                             sparse_row_id_fn=sparse_row_id_fn)
-                            except StopIteration:
-                                end_of_batch = True
-                                break
-                            if nb.data[0].shape != group[0].data[0].shape:
-                                next_data_batch = nb  # bucketing boundary
-                                break
-                            group.append(nb)
-                        else:
-                            try:
-                                next_data_batch = next(data_iter)
-                                self.prepare(next_data_batch,
-                                             sparse_row_id_fn=sparse_row_id_fn)
-                            except StopIteration:
-                                end_of_batch = True
-                    if len(group) > 1:
-                        with telemetry.span("fit.compute",
-                                            batches=len(group)):
-                            stacked = self._step_scan(group)
-                    else:
-                        stacked = False
-                    for k_i, b in enumerate(group):
-                        if stacked is False:  # unsupported: per-batch steps
-                            with telemetry.span("fit.compute"):
-                                self._step(b)
-                        with telemetry.span("fit.sync"):
-                            if stacked:
-                                outs = {name: out[k_i] for name, out in
-                                        zip(self.output_names, stacked)}
-                                eval_metric.update_dict(
-                                    dict(zip(self._label_names,
-                                             b.label or [])),
-                                    outs)
+                    with stepprof.step() as _sp:
+                        with stepprof.phase("data_wait",
+                                            gather="scan"):
+                            while len(group) < batches_per_dispatch:
+                                try:
+                                    nb = next(data_iter)
+                                    self.prepare(
+                                        nb,
+                                        sparse_row_id_fn=sparse_row_id_fn)
+                                except StopIteration:
+                                    end_of_batch = True
+                                    break
+                                if nb.data[0].shape != \
+                                        group[0].data[0].shape:
+                                    next_data_batch = nb  # bucket edge
+                                    break
+                                group.append(nb)
                             else:
-                                self.update_metric(eval_metric, b.label)
-                        _count_fit_batch(b)
-                        if batch_end_callback is not None:
-                            batch_end_params = BatchEndParam(
-                                epoch=epoch, nbatch=nbatch,
-                                eval_metric=eval_metric, locals=locals())
-                            for callback in _as_list(batch_end_callback):
-                                callback(batch_end_params)
-                        nbatch += 1
+                                try:
+                                    next_data_batch = next(data_iter)
+                                    self.prepare(
+                                        next_data_batch,
+                                        sparse_row_id_fn=sparse_row_id_fn)
+                                except StopIteration:
+                                    end_of_batch = True
+                        _sp["batches"] = len(group)
+                        if len(group) > 1:
+                            stacked = self._step_scan(group)
+                        else:
+                            stacked = False
+                        for k_i, b in enumerate(group):
+                            if stacked is False:  # per-batch fallback
+                                self._step(b)
+                            with stepprof.phase("device_compute",
+                                                via="update_metric"):
+                                if stacked:
+                                    outs = {name: out[k_i]
+                                            for name, out in
+                                            zip(self.output_names,
+                                                stacked)}
+                                    eval_metric.update_dict(
+                                        dict(zip(self._label_names,
+                                                 b.label or [])),
+                                        outs)
+                                else:
+                                    self.update_metric(eval_metric,
+                                                       b.label)
+                            _count_fit_batch(b)
+                            if batch_end_callback is not None:
+                                batch_end_params = BatchEndParam(
+                                    epoch=epoch, nbatch=nbatch,
+                                    eval_metric=eval_metric,
+                                    locals=locals())
+                                for callback in \
+                                        _as_list(batch_end_callback):
+                                    callback(batch_end_params)
+                            nbatch += 1
                     continue
                 data_batch = next_data_batch
-                with telemetry.span("fit.compute"):
+                with stepprof.step() as _sp:
                     if monitor is not None:
                         monitor.tic()
                         self.forward_backward(data_batch)
                         self.update()
                     else:
                         self._step(data_batch)
-                with telemetry.span("fit.data") as _dspan:
-                    try:
-                        next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch,
-                                     sparse_row_id_fn=sparse_row_id_fn)
-                    except StopIteration:
-                        end_of_batch = True
-                        _dspan["end_of_epoch"] = True
-                with telemetry.span("fit.sync"):
-                    # metric update reads outputs to host: this is where
-                    # the step's async device work is actually awaited
-                    self.update_metric(eval_metric, data_batch.label)
+                    with stepprof.phase("data_wait") as _dspan:
+                        try:
+                            next_data_batch = next(data_iter)
+                            self.prepare(next_data_batch,
+                                         sparse_row_id_fn=sparse_row_id_fn)
+                        except StopIteration:
+                            end_of_batch = True
+                            _dspan["end_of_epoch"] = True
+                    with stepprof.phase("device_compute",
+                                        via="update_metric"):
+                        self.update_metric(eval_metric, data_batch.label)
                 _count_fit_batch(data_batch)
                 if monitor is not None:
                     monitor.toc_print()

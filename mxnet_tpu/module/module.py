@@ -25,6 +25,7 @@ from ..initializer import Uniform, InitDesc
 from ..ndarray import NDArray, zeros as nd_zeros
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from .. import stepprof
 from .base_module import BaseModule, _check_input_names
 
 
@@ -384,8 +385,9 @@ class Module(BaseModule):
         name = None
         try:
             name = self._symbol.name
-        except Exception:
-            pass
+        except Exception as exc:  # headless symbol: class name fallback
+            from .. import telemetry
+            telemetry.swallowed("module.ledger_scope", exc)
         return name or type(self).__name__.lower()
 
     def _note_optimizer_bytes(self, state_arrays):
@@ -529,6 +531,16 @@ class Module(BaseModule):
         self._exec.forward(is_train=is_train)
 
     def _load_batch(self, data_batch):
+        # the h2d phase is TRAINING-step anatomy: only record it inside
+        # an open step record, so predict/score staging does not pollute
+        # the step_h2d_seconds histogram (and .prom-derived verdicts)
+        if stepprof.in_step():
+            with stepprof.phase("h2d"):
+                self._load_batch_impl(data_batch)
+        else:
+            self._load_batch_impl(data_batch)
+
+    def _load_batch_impl(self, data_batch):
         data = data_batch.data
         for name, arr in zip(self._data_names, data):
             dst = self._exec.arg_dict[name]
@@ -548,9 +560,10 @@ class Module(BaseModule):
         """Fused fwd+bwd: one compiled XLA dispatch (see executor)."""
         assert self.binded and self.params_initialized
         self._load_batch(data_batch)
-        if self._monitor is not None:
-            self._exec.forward(is_train=True)
-        self._exec.forward_backward()
+        with stepprof.phase("dispatch"):
+            if self._monitor is not None:
+                self._exec.forward(is_train=True)
+            self._exec.forward_backward()
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -566,28 +579,34 @@ class Module(BaseModule):
                 and self._exec.grad_dict.get(name) is not None]
         if self._update_on_kvstore:
             # list push/pull: the kvstore applies every key's update in
-            # one dispatch when the optimizer is fusable
-            self._kvstore.push([i for i, _, _ in live],
-                               [g for _, _, g in live])
-            self._kvstore.pull([i for i, _, _ in live],
-                               [self._exec.arg_dict[name]
-                                for _, name, _ in live])
-        else:
-            if self._kvstore:
+            # one dispatch when the optimizer is fusable. The whole
+            # push+apply+pull round-trip is gradient aggregation time.
+            with stepprof.phase("sync", via="kvstore_update"):
                 self._kvstore.push([i for i, _, _ in live],
                                    [g for _, _, g in live])
                 self._kvstore.pull([i for i, _, _ in live],
-                                   [g for _, _, g in live])
+                                   [self._exec.arg_dict[name]
+                                    for _, name, _ in live])
+        else:
+            if self._kvstore:
+                with stepprof.phase("sync", via="kvstore_reduce"):
+                    self._kvstore.push([i for i, _, _ in live],
+                                       [g for _, _, g in live])
+                    self._kvstore.pull([i for i, _, _ in live],
+                                       [g for _, _, g in live])
             if self._fused is None:
                 from .. import optimizer as opt
                 self._fused = opt.FusedApplier.resolve(self._updater)
-            if self._fused:
-                self._fused([i for i, _, _ in live],
-                            [self._exec.arg_dict[name] for _, name, _ in live],
-                            [g for _, _, g in live])
-            else:
-                for i, name, grad in live:
-                    self._updater(i, grad, self._exec.arg_dict[name])
+            with stepprof.phase("opt_update",
+                                fused=bool(self._fused)):
+                if self._fused:
+                    self._fused([i for i, _, _ in live],
+                                [self._exec.arg_dict[name]
+                                 for _, name, _ in live],
+                                [g for _, _, g in live])
+                else:
+                    for i, name, grad in live:
+                        self._updater(i, grad, self._exec.arg_dict[name])
             if self._updater is not None:
                 self._note_optimizer_bytes(
                     list(self._updater.states.values()))
@@ -611,18 +630,30 @@ class Module(BaseModule):
         live_names, indices, fused, step_fn, _ = self._fused_plan
         self._load_batch(data_batch)
         exec_ = self._exec
-        arg_vals, aux_vals = exec_._gather()
-        key = exec_._next_key()
-        grad_args = {n: arg_vals[n] for n in exec_._grad_names}
-        other_args = {n: v for n, v in arg_vals.items()
-                      if n not in exec_._grad_names}
-        weights = [exec_.arg_dict[n] for n in live_names]
-        lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
-        outs, aux_up, new_ws, new_states, grads = step_fn(
-            grad_args, other_args, aux_vals, key, lrs, wds, rescale,
-            state_vals)
+        with stepprof.phase("dispatch", site="module.fused_step"):
+            arg_vals, aux_vals = exec_._gather()
+            key = exec_._next_key()
+            grad_args = {n: arg_vals[n] for n in exec_._grad_names}
+            other_args = {n: v for n, v in arg_vals.items()
+                          if n not in exec_._grad_names}
+            weights = [exec_.arg_dict[n] for n in live_names]
+            lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+            outs, aux_up, new_ws, new_states, grads = step_fn(
+                grad_args, other_args, aux_vals, key, lrs, wds, rescale,
+                state_vals)
         from .. import xla_stats
         xla_stats.note_train_step(step_fn, batches=1)
+        if stepprof.should_sync():
+            # sampled sync: bracket the dispatch's results with a real
+            # device wait so device_compute is a measured tile of THIS
+            # step (the overlap estimator's ground truth); off the
+            # sampled steps the device runs hidden behind host phases
+            import jax
+            with stepprof.phase("device_compute", synced=True) as _dc:
+                jax.block_until_ready((outs, new_ws))
+            stepprof.note_device_sample(
+                _dc.seconds, batches=1,
+                flops_per_batch=xla_stats.flops_per_batch())
         self._note_optimizer_bytes(state_vals)
         for name, val in aux_up.items():
             exec_.aux_dict[name]._data = val
@@ -853,21 +884,35 @@ class Module(BaseModule):
                 self._scan_plans = {}
             self._scan_plans[plan_key] = scan_fn
 
-        placed = data_batches if isinstance(data_batches, dict) \
-            else self.stack_batches(data_batches)
+        if isinstance(data_batches, dict):
+            placed = data_batches  # prestacked: staging already paid
+        else:
+            with stepprof.phase("h2d", via="stack_batches"):
+                placed = self.stack_batches(data_batches)
 
-        arg_vals, aux_vals = exec_._gather()
-        grad_args = {n: arg_vals[n] for n in exec_._grad_names}
-        consts = {n: v for n, v in arg_vals.items()
-                  if n not in exec_._grad_names and n not in placed}
-        weights = [exec_.arg_dict[n] for n in live_names]
-        lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
-        key = exec_._next_key()
-        ga, aux, sv, outs = scan_fn(grad_args, consts, placed, aux_vals,
-                                    key, lrs, wds, rescale, state_vals)
+        with stepprof.phase("dispatch", site="module.scan_step"):
+            arg_vals, aux_vals = exec_._gather()
+            grad_args = {n: arg_vals[n] for n in exec_._grad_names}
+            consts = {n: v for n, v in arg_vals.items()
+                      if n not in exec_._grad_names and n not in placed}
+            weights = [exec_.arg_dict[n] for n in live_names]
+            lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+            key = exec_._next_key()
+            ga, aux, sv, outs = scan_fn(grad_args, consts, placed,
+                                        aux_vals, key, lrs, wds, rescale,
+                                        state_vals)
         from .. import xla_stats
         # the scanned executable's FLOPs cover all K carried batches
         xla_stats.note_train_step(scan_fn, batches=K)
+        if stepprof.should_sync():
+            # sampled sync (see _step): one real device wait covering
+            # the whole K-batch dispatch
+            with stepprof.phase("device_compute", synced=True,
+                                batches=K) as _dc:
+                jax.block_until_ready((ga, outs))
+            stepprof.note_device_sample(
+                _dc.seconds, batches=K,
+                flops_per_batch=xla_stats.flops_per_batch())
         self._note_optimizer_bytes(state_vals)
         for name, val in aux.items():
             exec_.aux_dict[name]._data = val

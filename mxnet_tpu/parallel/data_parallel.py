@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import stepprof as _stepprof
+
 __all__ = ["DataParallelTrainStep", "ShardedTrainStep",
            "split_and_load_sharded", "sgd_update"]
 
@@ -84,16 +86,30 @@ class DataParallelTrainStep:
         # GSPMD propagates them through the step. donate_params invalidates
         # the params/opt_state passed in (see _jit_step).
         self._step = _jit_step(loss_fn, optimizer_update, donate_params)
+        self._stepper = _stepprof.ImplicitStepper()
 
     def place_params(self, params):
         return jax.device_put(params, self.param_sharding)
 
     def place_batch(self, *batch):
-        return tuple(jax.device_put(b, self.batch_sharding) for b in batch)
+        # staging happens before the step call: carry the h2d seconds
+        # into the next bracketed step so they reach shares/verdict
+        with _stepprof.phase("h2d", via="data_parallel.place_batch") as ph:
+            out = tuple(jax.device_put(b, self.batch_sharding)
+                        for b in batch)
+        if not _stepprof.in_step():   # else the phase already landed
+            self._stepper.carry_phase("h2d", ph.seconds)
+        return out
 
     def __call__(self, params, opt_state, *batch):
         with self.mesh:
-            return self._step(params, opt_state, *batch)
+            # the user's loop owns iteration; the implicit stepper makes
+            # each call a stepprof step (wall reaches back to the last
+            # call) unless an explicit step is already open
+            with self._stepper.bracket(via="data_parallel"):
+                with _stepprof.phase("dispatch",
+                                     site="data_parallel.step"):
+                    return self._step(params, opt_state, *batch)
 
 
 class ShardedTrainStep:
@@ -122,6 +138,7 @@ class ShardedTrainStep:
         self._param_spec = param_spec
         self._batch_axis = batch_axis
         self._step = _jit_step(loss_fn, optimizer_update, donate_params)
+        self._stepper = _stepprof.ImplicitStepper()
 
     def _spec_tree(self, params):
         if callable(self._param_spec):
@@ -138,8 +155,15 @@ class ShardedTrainStep:
         # built lazily: a pure-tp mesh has no batch axis, and a user who
         # replicates inputs themselves never needs one
         sharding = NamedSharding(self.mesh, P(self._batch_axis))
-        return tuple(jax.device_put(b, sharding) for b in batch)
+        with _stepprof.phase("h2d", via="data_parallel.place_batch") as ph:
+            out = tuple(jax.device_put(b, sharding) for b in batch)
+        if not _stepprof.in_step():   # else the phase already landed
+            self._stepper.carry_phase("h2d", ph.seconds)
+        return out
 
     def __call__(self, params, opt_state, *batch):
         with self.mesh:
-            return self._step(params, opt_state, *batch)
+            with self._stepper.bracket(via="data_parallel"):
+                with _stepprof.phase("dispatch",
+                                     site="data_parallel.step"):
+                    return self._step(params, opt_state, *batch)

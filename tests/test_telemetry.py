@@ -313,9 +313,11 @@ def test_fit_phase_series_and_speedometer(fresh):
     mod.fit(it, num_epoch=2, eval_metric="acc")
     assert telemetry.counter("fit_batches_total").value == 8
     assert telemetry.counter("fit_samples_total").value == 128
-    for phase in ("data", "compute", "sync"):
-        h = telemetry.get_metric("fit_%s_seconds" % phase)
+    # PR 6: the ad-hoc fit.* spans became the stepprof taxonomy
+    for phase in ("data_wait", "h2d", "dispatch", "device_compute"):
+        h = telemetry.get_metric("step_%s_seconds" % phase)
         assert h is not None and h.count >= 8, phase
+    assert telemetry.get_metric("step_seconds").count >= 8
     # Speedometer reads samples/sec from the registry, not local math
     sp = mx.callback.Speedometer(batch_size=16, frequent=4)
     sp._mark()

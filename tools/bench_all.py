@@ -66,6 +66,24 @@ def bench_resnet50_infer():
                        + r.stdout[-2000:] + r.stderr[-2000:])
 
 
+def _parse_phase_breakdown(stdout):
+    """The last ``train_phase_breakdown`` JSON line a benchmark printed
+    (stepprof attribution pass), or None."""
+    found = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and \
+                rec.get("metric") == "train_phase_breakdown":
+            found = rec
+    return found
+
+
 def bench_resnet50_train():
     r, _ = _run([sys.executable,
                  "examples/image-classification/benchmark.py",
@@ -78,9 +96,16 @@ def bench_resnet50_train():
         raise RuntimeError("train benchmark produced no rate:\n"
                            + r.stdout[-2000:] + r.stderr[-2000:])
     v = float(m.group(1))
-    return {"metric": "resnet50_train_imgs_per_sec_bf16_bs128",
-            "value": v, "unit": "img/s",
-            "vs_baseline": round(v / BASELINES["resnet50_train"], 3)}
+    rec = {"metric": "resnet50_train_imgs_per_sec_bf16_bs128",
+           "value": v, "unit": "img/s",
+           "vs_baseline": round(v / BASELINES["resnet50_train"], 3)}
+    # step-time anatomy: p50 share per phase + verdict, so the BENCH
+    # history (and bench_gate failures) carry attribution with the rate
+    pb = _parse_phase_breakdown(r.stdout)
+    if pb:
+        rec["phases"] = pb.get("phases") or {}
+        rec["verdict"] = pb.get("verdict")
+    return rec
 
 
 def _bench_lstm(dtype):

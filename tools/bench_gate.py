@@ -60,14 +60,26 @@ def _numeric(v):
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
-def load_history(history_dir=None):
-    """{metric: [(value, source), ...]} from the recorded rounds."""
+def load_history(history_dir=None, with_phases=False):
+    """{metric: [(value, source), ...]} from the recorded rounds.
+
+    ``with_phases=True`` returns ``(history, phases)`` where ``phases``
+    maps ``(metric, source)`` to the ``"phases"`` share dict of the best
+    record that source saw (absent for rounds recorded before the
+    step-time profiler existed)."""
     history_dir = history_dir or REPO
     out = {}
+    phases = {}
 
-    def add(metric, value, source):
-        if metric and _numeric(value):
-            out.setdefault(metric, []).append((float(value), source))
+    def add(metric, value, source, rec=None):
+        if not (metric and _numeric(value)):
+            return
+        out.setdefault(metric, []).append((float(value), source))
+        ph = (rec or {}).get("phases")
+        if isinstance(ph, dict):
+            prev = phases.get((metric, source))
+            if prev is None or float(value) > prev[0]:
+                phases[(metric, source)] = (float(value), ph)
 
     paths = sorted(glob.glob(os.path.join(history_dir, "BENCH_*.json")))
     for path in paths:
@@ -80,17 +92,17 @@ def load_history(history_dir=None):
         if isinstance(doc, list):   # BENCH_EXTRA.json: a record array
             for rec in doc:
                 if isinstance(rec, dict):
-                    add(rec.get("metric"), rec.get("value"), name)
+                    add(rec.get("metric"), rec.get("value"), name, rec)
             continue
         if not isinstance(doc, dict):
             continue
         parsed = doc.get("parsed") or {}
         if isinstance(parsed, dict):
-            add(parsed.get("metric"), parsed.get("value"), name)
+            add(parsed.get("metric"), parsed.get("value"), name, parsed)
         tail = doc.get("tail")
         if isinstance(tail, str):
             for rec in parse_lines(tail.splitlines()):
-                add(rec.get("metric"), rec.get("value"), name)
+                add(rec.get("metric"), rec.get("value"), name, rec)
     base = os.path.join(history_dir, "BASELINE.json")
     if os.path.exists(base):
         try:
@@ -108,6 +120,8 @@ def load_history(history_dir=None):
                 best[src] = v
         out[metric] = sorted(((v, s) for s, v in best.items()),
                              reverse=True)
+    if with_phases:
+        return out, {k: ph for k, (_v, ph) in phases.items()}
     return out
 
 
@@ -119,10 +133,48 @@ def _run_platform(records):
     return None
 
 
+def _phase_delta_line(records, metric, best_src, phase_hist, out):
+    """On a regression, print the step-time anatomy next to the failure
+    so the gate arrives pre-diagnosed: the run's phase shares, the best
+    round's (when its record carried them), and the biggest movers."""
+    run_phases = None
+    for rec in records:
+        if rec.get("metric") == metric and isinstance(rec.get("phases"),
+                                                      dict):
+            run_phases = rec["phases"]
+    best_phases = phase_hist.get((metric, best_src))
+    line = {"metric": "bench_gate_phases", "gated": metric}
+    if run_phases:
+        line["run"] = run_phases
+    if best_phases:
+        line["best"] = dict(best_phases, _source=best_src)
+    if run_phases and best_phases:
+        deltas = {p: round(run_phases.get(p, 0.0)
+                           - float(best_phases.get(p, 0.0)), 4)
+                  for p in set(run_phases) | set(best_phases)
+                  if p != "_source"}
+        movers = sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:3]
+        line["delta"] = deltas
+        line["detail"] = "phase shift vs %s: %s" % (
+            best_src, ", ".join("%s %+.0f%%" % (p, d * 100.0)
+                                for p, d in movers))
+    elif run_phases:
+        line["detail"] = ("run verdict: %s (no phase history recorded "
+                          "for %s)" % (next(
+                              (r.get("verdict") for r in records
+                               if r.get("metric") == metric), None),
+                              best_src))
+    else:
+        line["detail"] = ("no phase attribution in this run — rerun "
+                          "bench.py (stepprof) for a pre-diagnosed "
+                          "failure")
+    out.write(json.dumps(line) + "\n")
+
+
 def gate_records(records, history_dir=None, metric=None,
                  threshold=DEFAULT_THRESHOLD, strict=False, out=sys.stdout):
     """Gate already-parsed run records; returns the process exit code."""
-    history = load_history(history_dir)
+    history, phase_hist = load_history(history_dir, with_phases=True)
 
     def say(status, detail, **extra):
         line = dict({"metric": "bench_gate", "status": status,
@@ -176,6 +228,7 @@ def gate_records(records, history_dir=None, metric=None,
         "threshold %.0f%%)" % (metric, value, floor, best, best_src,
                                threshold * 100),
         value=value, best=best, floor=floor)
+    _phase_delta_line(records, metric, best_src, phase_hist, out)
     return 1
 
 
