@@ -83,10 +83,15 @@ def tools_import(name):
     return importlib.import_module(name)
 
 
-def run_gate(metric=None):
-    """Gate this run's RECORDS against the repo history; exits."""
-    raise SystemExit(tools_import("bench_gate").gate_records(
-        RECORDS, metric=metric))
+def run_gate(*metrics):
+    """Gate this run's RECORDS against the repo history (one
+    gate_records pass per metric; default metric selection when none
+    given); exits with the worst result."""
+    gate = tools_import("bench_gate")
+    if not metrics:
+        raise SystemExit(gate.gate_records(RECORDS))
+    raise SystemExit(max(gate.gate_records(RECORDS, metric=m)
+                         for m in metrics))
 
 
 def bench_serve():
@@ -103,9 +108,10 @@ def main():
         bench_serve()
         write_telemetry_snapshot()
         if "--gate" in sys.argv:
-            # gate the serving headline, not the TRAIN metric this run
-            # never emitted (which would skip-pass unconditionally)
-            run_gate("serving_closed_rps")
+            # gate the serving headlines, not the TRAIN metric this run
+            # never emitted (which would skip-pass unconditionally):
+            # throughput down OR p99 latency up both fail the round
+            run_gate("serving_closed_rps", "serving_closed_p99_ms")
         return
     import jax
     import jax.numpy as jnp

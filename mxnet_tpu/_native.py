@@ -96,7 +96,12 @@ def _build():
         subprocess.run(["make", "-s"], cwd=_SRC_DIR, check=True,
                        capture_output=True, timeout=300)
         return os.path.isfile(_SO_PATH)
-    except Exception:
+    except Exception as exc:
+        # no toolchain / failed make degrades to the pure-python paths;
+        # counted + debug-logged so "why is the native lib off" has an
+        # answer without rerunning make by hand
+        from . import telemetry
+        telemetry.swallowed("_native.build", exc)
         return False
 
 

@@ -215,8 +215,10 @@ def maybe_die():
             from . import xla_stats
             xla_stats.dump_flight_recorder("chaos.worker.death",
                                            error="os._exit(%d)" % code)
-        except Exception:
-            pass
+        except Exception as exc:
+            # best-effort post-mortem on a deliberate death path: the
+            # dump failing must not stop the exit, but it stays counted
+            telemetry.swallowed("chaos.flight_recorder", exc)
         telemetry.flush()  # os._exit skips atexit; keep the logs durable
         os._exit(code)
 

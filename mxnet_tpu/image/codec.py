@@ -13,6 +13,7 @@ import numpy as np
 try:
     import cv2
     _HAS_CV2 = True
+# mxanalyze: allow(swallowed-exception): optional codec backend — a missing OR broken cv2 install (ABI mismatch raises ImportError subclasses and worse) degrades to the PIL/none path, surfaced by _HAS_CV2
 except Exception:  # pragma: no cover
     _HAS_CV2 = False
 
@@ -20,6 +21,7 @@ try:
     from PIL import Image
     import io as _pyio
     _HAS_PIL = True
+# mxanalyze: allow(swallowed-exception): optional codec backend — a missing or broken PIL degrades to the cv2/none path, surfaced by _HAS_PIL
 except Exception:  # pragma: no cover
     _HAS_PIL = False
 

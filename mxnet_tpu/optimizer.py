@@ -655,7 +655,11 @@ def _on_accelerator(weights):
     try:
         dev = next(iter(weights[0]._data.devices()))
         return dev.platform != "cpu"
-    except Exception:
+    except Exception as exc:
+        # un-probe-able placement degrades to the safe no-donation
+        # answer; counted so a donation regression is explainable
+        from . import telemetry
+        telemetry.swallowed("optimizer.on_accelerator", exc)
         return False
 
 

@@ -66,7 +66,12 @@ def _resolve_device(ctx):
     if hasattr(ctx, "jax_device"):
         try:
             return ctx.jax_device()
-        except Exception:
+        except Exception as exc:
+            # a context without a live backing device resolves to None
+            # (callers fall back to the default chain) — counted, so a
+            # systematically unresolvable device is visible
+            from . import telemetry
+            telemetry.swallowed("random.resolve_device", exc)
             return None
     return ctx
 

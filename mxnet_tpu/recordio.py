@@ -87,9 +87,8 @@ class MXRecordIO:
     def __del__(self):
         try:
             self.close()
+        # mxanalyze: allow(swallowed-exception): __del__ at interpreter shutdown — builtins/telemetry may already be torn down, and raising from __del__ only prints noise; explicitly-closed handles never hit this
         except Exception:
-            # interpreter shutdown may have torn down builtins (open);
-            # explicitly-closed handles never hit this
             pass
 
     def __getstate__(self):

@@ -179,7 +179,11 @@ class ResourceManager:
             if target is not None:
                 try:
                     rdev = r.ctx.jax_device()
-                except Exception:
+                except Exception as exc:
+                    # device-less resource contexts never match a
+                    # targeted reseed; counted rather than silent
+                    from . import telemetry
+                    telemetry.swallowed("resource.seed_device", exc)
                     rdev = None
                 if rdev != target:
                     continue

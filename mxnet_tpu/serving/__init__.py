@@ -1,5 +1,5 @@
 """Inference serving subsystem: dynamic micro-batching over bucketed
-shapes, backpressure, and an HTTP front end.
+shapes, backpressure, an HTTP front end, and per-request observability.
 
 The first subsystem on the inference side of the stack — built on the
 substrate of the last three PRs (elastic supervision, the telemetry
@@ -9,20 +9,33 @@ a bounded set of batch-size buckets, all warm-compiled at startup.
 
 Layers (each its own module, composable without the ones above it):
 
-- `batching` — pure bucketing math (ladder, pick, pad, split);
+- `batching` — pure bucketing math (ladder, pick, pad, split) plus the
+  :class:`PadLedger` pad-waste accounting;
+- `reqtrace` — request anatomy: per-request trace ids + the fixed
+  ``queue_wait/batch_wait/pad/dispatch/device_compute/split/respond``
+  phase taxonomy, the :class:`SLOTracker` burn-rate gauges, and the
+  ``python -m mxnet_tpu.serving.reqtrace report`` tail-latency
+  attribution CLI;
 - `engine` — :class:`InferenceEngine`: replica pool, bounded queue,
   dynamic micro-batching, deadlines, load shedding
   (:class:`RequestRejected`), drain/shutdown, worker crash recovery;
-- `server` — stdlib ``ThreadingHTTPServer`` front end: ``/predict``,
-  ``/healthz``, ``/metrics`` (Prometheus text).
+- `server` — stdlib ``ThreadingHTTPServer`` front end: ``/predict``
+  (with ``X-Request-Id`` propagation), ``/healthz`` (saturation-aware),
+  ``/metrics`` (Prometheus text).
 
-Design note: docs/architecture/serving.md. Env knobs: docs/env_var.md
-(``MXNET_SERVING_*``).
+Design note: docs/architecture/serving.md + the "Request anatomy"
+section of docs/architecture/observability.md. Env knobs:
+docs/env_var.md (``MXNET_SERVING_*``, ``MXNET_REQTRACE_*``,
+``MXNET_SLO_*``).
 """
-from .batching import bucket_sizes, pick_bucket, pad_rows, split_rows
+from .batching import (bucket_sizes, pick_bucket, pad_rows, split_rows,
+                       PadLedger)
+from . import reqtrace
+from .reqtrace import SLOTracker
 from .engine import EngineConfig, InferenceEngine, RequestRejected
 from .server import ServingHTTPServer, serve
 
 __all__ = ["bucket_sizes", "pick_bucket", "pad_rows", "split_rows",
+           "PadLedger", "reqtrace", "SLOTracker",
            "EngineConfig", "InferenceEngine", "RequestRejected",
            "ServingHTTPServer", "serve"]

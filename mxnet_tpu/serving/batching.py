@@ -12,14 +12,21 @@ than 2n rows, so at most half the compute of a worst-case batch is
 padding (and measured batches cluster at the buckets under load, where
 waste goes to zero).
 
-Pure functions over numpy arrays; no engine state, no jax — unit-testable
-in isolation (`tests/test_serving.py`).
+Pure functions over numpy arrays plus one small stateful piece: the
+:class:`PadLedger`, the cumulative pad-waste accounting behind
+``serving_pad_waste_ratio`` / ``serving_bucket_occupancy{bucket=}``
+(`serving/reqtrace.py` owns the process-wide instance). No jax —
+unit-testable in isolation (`tests/test_serving.py`,
+`tests/test_reqtrace.py`).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
-__all__ = ["bucket_sizes", "pick_bucket", "pad_rows", "split_rows"]
+__all__ = ["bucket_sizes", "pick_bucket", "pad_rows", "split_rows",
+           "PadLedger"]
 
 
 def bucket_sizes(max_batch):
@@ -67,6 +74,73 @@ def pad_rows(arr, bucket):
         raise ValueError("batch of %d rows > bucket %d" % (n, bucket))
     pad = np.repeat(arr[-1:], bucket - n, axis=0)
     return np.concatenate([arr, pad], axis=0)
+
+
+class PadLedger:
+    """Cumulative pad-waste accounting per bucket (thread-safe).
+
+    The per-batch ``serving_batch_occupancy`` histogram answers "how
+    full was a typical batch"; the ledger answers the aggregate
+    question tail attribution needs: of every row the device computed,
+    what fraction was padding, and WHICH bucket is burning it. Bounded
+    by the bucket ladder (a handful of entries), so it never resets in
+    a long-lived server."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets = {}   # bucket -> [batches, real_rows]
+
+    def note(self, rows, bucket):
+        """Account one dispatched batch: ``rows`` real rows padded up
+        to ``bucket`` rows."""
+        rows, bucket = int(rows), int(bucket)
+        if not 1 <= rows <= bucket:
+            raise ValueError("rows must be in [1, bucket=%d], got %d"
+                             % (bucket, rows))
+        with self._lock:
+            ent = self._buckets.setdefault(bucket, [0, 0])
+            ent[0] += 1
+            ent[1] += rows
+
+    def occupancy(self, bucket):
+        """Real rows / dispatched rows for one bucket (None when the
+        bucket never dispatched)."""
+        with self._lock:
+            ent = self._buckets.get(int(bucket))
+        if not ent or not ent[0]:
+            return None
+        return ent[1] / float(ent[0] * int(bucket))
+
+    def waste_ratio(self):
+        """Padding rows / all dispatched rows (0.0 before any batch)."""
+        with self._lock:
+            items = list(self._buckets.items())
+        total = sum(b * ent[0] for b, ent in items)
+        real = sum(ent[1] for _b, ent in items)
+        if not total:
+            return 0.0
+        return 1.0 - real / float(total)
+
+    def snapshot(self):
+        """JSON-able view: overall waste ratio + per-bucket batches /
+        real rows / occupancy."""
+        with self._lock:
+            items = sorted(self._buckets.items())
+        buckets = {}
+        total = real = 0
+        for b, (n, r) in items:
+            disp = b * n
+            total += disp
+            real += r
+            buckets[str(b)] = {"batches": n, "real_rows": r,
+                               "occupancy": round(r / float(disp), 4)
+                               if disp else None}
+        return {"waste_ratio": (1.0 - real / float(total)) if total
+                else 0.0, "buckets": buckets}
+
+    def reset(self):
+        with self._lock:
+            self._buckets = {}
 
 
 def split_rows(arr, counts):

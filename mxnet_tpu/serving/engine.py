@@ -21,6 +21,15 @@ surface; this module turns it into a production-shaped serving stack:
   recorder, and is respawned — chaos sites ``serving.slow_request`` and
   ``serving.worker_death`` prove both paths on demand.
 
+- **Request anatomy** (`serving/reqtrace.py`) — every request carries a
+  trace id (``rid``) and a monotonic boundary-mark trace; the engine
+  marks enqueue, batcher pickup, pad/dispatch/readback/split boundaries
+  and resolve, so each completed request decomposes into the fixed
+  ``queue_wait/batch_wait/pad/dispatch/device_compute/split/respond``
+  taxonomy (phases telescope to the request's wall latency exactly).
+  An :class:`reqtrace.SLOTracker` per engine turns outcomes into
+  multi-window burn-rate gauges.
+
 Telemetry (all in the process-wide registry, scraped by
 ``serving/server.py`` ``/metrics``):
 
@@ -28,10 +37,14 @@ Telemetry (all in the process-wide registry, scraped by
 - ``serving_batches_total{bucket=}`` and ``serving_batch_occupancy``
   (real rows / bucket rows — padding waste is 1 minus this)
 - ``serving_queue_wait_seconds`` / ``serving_compute_seconds`` /
-  ``serving_total_seconds`` latency histograms
+  ``serving_total_seconds`` latency histograms, plus the per-phase
+  ``serving_req_phase_seconds{phase=}`` anatomy histograms
 - ``serving_queue_depth`` / ``serving_workers_alive`` /
   ``serving_inflight_requests`` gauges (scrape-time sampled)
 - ``serving_worker_deaths_total`` / ``serving_worker_respawns_total``
+- ``serving_pad_waste_ratio`` / ``serving_bucket_occupancy{bucket=}``
+  and ``serving_{real,pad}_rows_total{bucket=}`` (the pad ledger)
+- ``serving_slo_burn_rate{window=}`` / ``serving_slo_target_ms``
 
 Defaults come from ``MXNET_SERVING_*`` env vars (docs/env_var.md) via
 :class:`EngineConfig`.
@@ -46,6 +59,7 @@ tap.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import queue as _queue
@@ -61,6 +75,7 @@ from .. import telemetry
 from .. import xla_stats
 from ..base import MXNetError
 from ..predict import Predictor
+from . import reqtrace
 from .batching import bucket_sizes, pick_bucket, pad_rows, split_rows
 
 __all__ = ["EngineConfig", "InferenceEngine", "RequestRejected"]
@@ -77,21 +92,13 @@ class RequestRejected(MXNetError):
     down). Distinct from a compute error so clients can retry/back off
     on rejection but not on a genuine failure."""
 
-    def __init__(self, status, message):
+    def __init__(self, status, message, rid=None):
         super().__init__(message)
         self.status = status
+        self.rid = rid   # trace id, when the rejection got far enough
 
 
-def _env_num(name, default, cast):
-    val = os.environ.get(name)
-    if not val:
-        return default
-    try:
-        return cast(val)
-    except ValueError:
-        logger.warning("bad %s=%r ignored (want %s)", name, val,
-                       cast.__name__)
-        return default
+_env_num = reqtrace._env_num
 
 
 class EngineConfig:
@@ -142,14 +149,18 @@ class EngineConfig:
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "future", "enqueued", "deadline")
+    __slots__ = ("inputs", "n", "future", "enqueued", "deadline", "rid",
+                 "trace")
 
-    def __init__(self, inputs, n, deadline):
+    def __init__(self, inputs, n, deadline, rid=None):
         self.inputs = inputs
         self.n = n
         self.future = Future()
         self.enqueued = time.monotonic()
         self.deadline = deadline
+        self.rid = reqtrace.clean_request_id(rid)
+        self.trace = reqtrace.Trace(self.rid)
+        self.trace.mark("enqueued", self.enqueued)
 
 
 class _Batch:
@@ -244,6 +255,8 @@ class InferenceEngine:
 
         self._queue = _queue.Queue(maxsize=self.config.max_queue)
         self._work = _queue.Queue(maxsize=len(self._replicas))
+        self._batch_seq = itertools.count(1)   # batch ids for span linkage
+        self._slo = reqtrace.SLOTracker()
         self._cond = threading.Condition()
         self._pending = 0          # submitted, not yet resolved
         self._draining = False
@@ -304,6 +317,18 @@ class InferenceEngine:
                         help="configured batch-size buckets",
                         engine=self._engine_label).set(
                             len(self._buckets))
+        telemetry.gauge("serving_slo_target_ms",
+                        help="per-request latency SLO target",
+                        engine=self._engine_label).set(
+                            self._slo.target_ms)
+        for w in self._slo.windows:
+            telemetry.gauge(
+                "serving_slo_burn_rate",
+                help="SLO error-budget burn rate per trailing window "
+                     "(bad fraction / error budget; >1 = burning "
+                     "faster than the SLO allows)",
+                engine=self._engine_label, window=str(w)).set_function(
+                    sampler(lambda e, w=w: e._slo.burn_rate(w)))
 
     def warm(self):
         """Run one dummy forward per (replica, bucket): every executable
@@ -362,7 +387,7 @@ class InferenceEngine:
         rep.thread.start()
 
     # -- client surface ---------------------------------------------------
-    def submit(self, inputs, deadline_ms=None):
+    def submit(self, inputs, deadline_ms=None, rid=None):
         """Enqueue one request of ``n`` examples; returns a
         ``concurrent.futures.Future`` resolving to a list of numpy
         arrays (one per output, each ``(n, ...)``).
@@ -372,6 +397,10 @@ class InferenceEngine:
         from NOW (default ``config.default_deadline_ms``; 0 = none); a
         request that cannot start computing before its deadline resolves
         to :class:`RequestRejected` instead of occupying a bucket.
+        ``rid``: caller-supplied trace id (the HTTP front end propagates
+        ``X-Request-Id`` here); generated when absent — it threads
+        through the reqtrace spans, the slow-request ring, and
+        rejection errors.
 
         Raises :class:`RequestRejected` immediately when the engine is
         draining/closed, the deadline is already non-positive, or the
@@ -382,12 +411,13 @@ class InferenceEngine:
         deadline = None
         if deadline_ms:
             if deadline_ms <= 0:
-                self._count("expired")
+                rid = reqtrace.clean_request_id(rid)
+                self._reject("expired", rid=rid)
                 raise RequestRejected(
                     "expired", "deadline_ms=%g already expired at submit"
-                    % deadline_ms)
+                    % deadline_ms, rid=rid)
             deadline = time.monotonic() + deadline_ms / 1000.0
-        req = _Request(arrays, n, deadline)
+        req = _Request(arrays, n, deadline, rid=rid)
         # intake is gated under the condition lock so shutdown() can
         # flip _draining/_closed and flush the queue with the guarantee
         # that no request lands AFTER the flush (whose future nothing
@@ -403,19 +433,20 @@ class InferenceEngine:
                 except _queue.Full:
                     status = "shed"
         if status == "closed":
-            self._count("closed")
+            self._reject("closed", rid=req.rid)
             raise RequestRejected("closed", "engine is shut down or "
-                                            "draining")
+                                            "draining", rid=req.rid)
         if status == "shed":
-            self._count("shed")
+            self._reject("shed", rid=req.rid)
             raise RequestRejected(
                 "shed", "queue full (%d requests waiting); retry with "
-                "backoff" % self.config.max_queue)
+                "backoff" % self.config.max_queue, rid=req.rid)
         return req.future
 
-    def predict(self, inputs, deadline_ms=None, timeout=None):
+    def predict(self, inputs, deadline_ms=None, timeout=None, rid=None):
         """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(inputs, deadline_ms=deadline_ms,
+                           rid=rid).result(timeout)
 
     def drain(self, timeout=None):
         """Stop accepting new requests (they get ``status="closed"``)
@@ -495,9 +526,15 @@ class InferenceEngine:
             for rep in self._replicas:
                 if rep.thread is not None:
                     rep.thread.join(timeout=30)
-            for name in ("serving_queue_depth", "serving_workers_alive",
-                         "serving_inflight_requests"):
-                g = telemetry.get_metric(name, engine=self._engine_label)
+            frozen = [telemetry.get_metric(name, engine=self._engine_label)
+                      for name in ("serving_queue_depth",
+                                   "serving_workers_alive",
+                                   "serving_inflight_requests")]
+            frozen += [telemetry.get_metric("serving_slo_burn_rate",
+                                            engine=self._engine_label,
+                                            window=str(w))
+                       for w in self._slo.windows]
+            for g in frozen:
                 if g is not None:
                     g.set(g.read())
                     g.set_function(None)
@@ -527,10 +564,13 @@ class InferenceEngine:
         self.shutdown()
 
     def stats(self):
-        """Live snapshot for health endpoints."""
+        """Live snapshot for health endpoints. ``queue_depth`` /
+        ``pending`` / ``slo.burn_rate`` are the saturation signals a
+        load balancer can act on before the drain flags flip."""
         return {
             "queue_depth": self._queue.qsize(),
             "pending": self._pending,
+            "slo": self._slo.snapshot(),
             "workers_alive": sum(1 for r in self._replicas
                                  if r.thread is not None
                                  and r.thread.is_alive()),
@@ -589,10 +629,22 @@ class InferenceEngine:
                           help="serving requests by final status",
                           status=status).inc()
 
+    def _reject(self, status, rid=None):
+        """Account a request refused at submit: it never got a trace
+        through the pipeline, but it still burns SLO budget and feeds
+        the shed-heavy verdict."""
+        self._count(status)
+        self._slo.record(False)
+        reqtrace.tracer.note_reject(status)
+
     def _resolve(self, req, result=None, exc=None, status="ok"):
         with self._cond:
             self._pending -= 1
             self._cond.notify_all()
+        # the request's clock stops HERE — before set_result, whose
+        # done-callbacks run arbitrary client code inline; latency,
+        # SLO, and the trace's respond phase all share this boundary
+        end = time.monotonic()
         try:
             if exc is not None:
                 req.future.set_exception(exc)
@@ -600,7 +652,7 @@ class InferenceEngine:
                 telemetry.histogram(
                     "serving_total_seconds",
                     help="submit-to-result latency of served requests"
-                ).observe(time.monotonic() - req.enqueued)
+                ).observe(end - req.enqueued)
                 req.future.set_result(result)
         except InvalidStateError:
             # a client cancelled the Future while it was queued;
@@ -608,6 +660,11 @@ class InferenceEngine:
             # batcher/worker thread that resolves it
             status = "cancelled" if req.future.cancelled() else status
         self._count(status)
+        if status == "ok":
+            self._slo.record(True, end - req.enqueued)
+        elif status != "cancelled":   # a walked-away client is not an
+            self._slo.record(False)   # availability failure of ours
+        reqtrace.tracer.record(req.trace, end, status=status)
 
     def _batch_loop(self):
         cfg = self.config
@@ -620,6 +677,7 @@ class InferenceEngine:
                 req = self._queue.get()
                 if req is _STOP:
                     break
+                req.trace.mark("picked")
             reqs, rows = [req], req.n
             t_close = time.monotonic() + cfg.max_batch_delay_ms / 1000.0
             while rows < cfg.max_batch_size and not stopping:
@@ -633,6 +691,7 @@ class InferenceEngine:
                 if nxt is _STOP:
                     stopping = True
                     break
+                nxt.trace.mark("picked")
                 if rows + nxt.n > cfg.max_batch_size:
                     carry = nxt   # head-of-line for the NEXT batch
                     break
@@ -701,16 +760,37 @@ class InferenceEngine:
         if chaos.fire("serving.worker_death") is not None:
             raise _WorkerDeath("chaos: injected serving worker death")
 
+        # the anatomy boundaries: batch_wait ends (and pad begins) here,
+        # so chaos stalls and the deadline sweep above land in
+        # batch_wait, and the remaining marks telescope to resolve
+        bid = next(self._batch_seq)
+        real_rows = sum(r.n for r in live)
+        reqtrace.tracer.note_batch(real_rows, batch.bucket)
+        t_pad = time.monotonic()
+        for req in live:
+            req.trace.bucket = batch.bucket
+            req.trace.batch = bid
+            req.trace.mark("pad_start", t_pad)
         t0 = time.perf_counter()
+        batch_span = telemetry.span(
+            "serving.batch", batch=bid, bucket=batch.bucket,
+            rows=real_rows, replica=rep.index,
+            rids=[r.rid for r in live])
         try:
-            pred = rep.preds[batch.bucket]
-            feed = {}
-            for name in self._example_shapes:
-                rows = [r.inputs[name] for r in live]
-                arr = rows[0] if len(rows) == 1 else np.concatenate(rows)
-                feed[name] = pad_rows(arr, batch.bucket)
-            pred.forward(**feed)
-            outs = [pred.get_output(i) for i in range(self.num_outputs)]
+            with batch_span:
+                pred = rep.preds[batch.bucket]
+                feed = {}
+                for name in self._example_shapes:
+                    rows = [r.inputs[name] for r in live]
+                    arr = rows[0] if len(rows) == 1 \
+                        else np.concatenate(rows)
+                    feed[name] = pad_rows(arr, batch.bucket)
+                t_fwd = time.monotonic()       # pad done
+                pred.forward(**feed)
+                t_disp = time.monotonic()      # async dispatch returned
+                outs = [pred.get_output(i)
+                        for i in range(self.num_outputs)]
+                t_out = time.monotonic()       # device results read back
         except Exception as exc:
             logger.exception("serving: batch of %d rows failed on "
                              "replica %d", batch.rows, rep.index)
@@ -726,6 +806,12 @@ class InferenceEngine:
                           bucket=str(batch.bucket)).inc()
         counts = [r.n for r in live]
         splits = [split_rows(o, counts) for o in outs]
+        t_split = time.monotonic()
+        for req in live:
+            req.trace.mark("pad_end", t_fwd)
+            req.trace.mark("forward_end", t_disp)
+            req.trace.mark("outputs_end", t_out)
+            req.trace.mark("split_end", t_split)
         for i, req in enumerate(live):
             self._resolve(req, result=[s[i] for s in splits])
 

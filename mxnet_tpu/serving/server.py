@@ -13,13 +13,27 @@ exposing:
 - ``GET /healthz`` — engine liveness: 200 with the `stats()` dict while
   accepting and at least one replica worker is alive, 503 otherwise
   (a draining engine fails its health check first, so a balancer stops
-  routing to it before shutdown — the graceful-removal dance).
+  routing to it before shutdown — the graceful-removal dance). The
+  body carries the saturation signals too — ``queue_depth``,
+  ``pending`` (in-flight), and ``slo.burn_rate`` per window — so a
+  balancer can shift traffic off a saturated-but-alive replica, not
+  just a draining one.
 - ``GET /metrics`` — the whole telemetry registry as Prometheus text
   (`telemetry.dumps()`): serving counters/histograms, compile
   accounting, everything the process recorded.
 - ``POST /shutdown`` — only when constructed with
   ``allow_shutdown=True`` (tests / supervised deployments): drains the
   engine and stops the server.
+
+Request tracing (`serving/reqtrace.py`): every request gets a trace id
+— the ``X-Request-Id`` header when the client sent one (sanitized),
+generated otherwise — propagated into the engine's per-request
+anatomy, echoed back as an ``X-Request-Id`` response header on every
+route, and embedded as ``request_id`` in error bodies so a failing
+request can be joined to its ``serving.request`` span in the telemetry
+JSONL. Every route also feeds per-route status/latency series:
+``serving_http_requests_total{route=,code=}`` and
+``serving_http_seconds{route=}``.
 
 CLI (used by the launched serving test)::
 
@@ -34,12 +48,14 @@ import argparse
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from .. import telemetry
 from ..base import MXNetError
+from . import reqtrace
 from .engine import EngineConfig, InferenceEngine, RequestRejected
 
 __all__ = ["serve", "ServingHTTPServer", "main"]
@@ -55,8 +71,15 @@ _REJECT_HTTP = {"shed": 429, "expired": 504, "closed": 503}
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+_ROUTES = ("/predict", "/healthz", "/metrics", "/shutdown")
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+
+    # set per request in _handle before route dispatch
+    _rid = None
+    _code = 0
 
     # -- plumbing ---------------------------------------------------------
     def log_message(self, fmt, *args):   # stderr spam -> debug log
@@ -64,22 +87,55 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_json(self, code, doc):
         body = json.dumps(doc).encode("utf-8")
+        self._code = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self._rid:
+            self.send_header("X-Request-Id", self._rid)
         self.end_headers()
         self.wfile.write(body)
 
     def _send_text(self, code, text, content_type="text/plain"):
         body = text.encode("utf-8")
+        self._code = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self._rid:
+            self.send_header("X-Request-Id", self._rid)
         self.end_headers()
         self.wfile.write(body)
 
+    def _handle(self, dispatch):
+        """Route dispatch wrapper: resolve the trace id (propagate the
+        client's ``X-Request-Id`` or mint one) and feed the per-route
+        status/latency series whatever the route does."""
+        self._rid = reqtrace.clean_request_id(
+            self.headers.get("X-Request-Id"))
+        self._code = 0
+        route = self.path if self.path in _ROUTES else "other"
+        t0 = time.monotonic()
+        try:
+            dispatch()
+        finally:
+            telemetry.histogram(
+                "serving_http_seconds",
+                help="HTTP handler wall time by route",
+                route=route).observe(time.monotonic() - t0)
+            telemetry.counter(
+                "serving_http_requests_total",
+                help="HTTP requests by route and status code",
+                route=route, code=str(self._code)).inc()
+
     # -- routes -----------------------------------------------------------
     def do_GET(self):
+        self._handle(self._get)
+
+    def do_POST(self):
+        self._handle(self._post)
+
+    def _get(self):
         if self.path == "/healthz":
             st = self.server.engine.stats()
             healthy = (not st["closed"] and not st["draining"]
@@ -90,9 +146,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_text(200, telemetry.dumps(),
                             content_type=PROM_CONTENT_TYPE)
         else:
-            self._send_json(404, {"error": "no route %r" % self.path})
+            self._send_json(404, {"error": "no route %r" % self.path,
+                                  "request_id": self._rid})
 
-    def do_POST(self):
+    def _post(self):
         if self.path == "/predict":
             self._predict()
         elif self.path == "/shutdown" and self.server.allow_shutdown:
@@ -102,7 +159,8 @@ class _Handler(BaseHTTPRequestHandler):
             threading.Thread(target=self.server.stop,
                              daemon=True).start()
         else:
-            self._send_json(404, {"error": "no route %r" % self.path})
+            self._send_json(404, {"error": "no route %r" % self.path,
+                                  "request_id": self._rid})
 
     def _predict(self):
         try:
@@ -112,11 +170,13 @@ class _Handler(BaseHTTPRequestHandler):
         if length <= 0:
             return self._send_json(400, {"error": "a JSON body with "
                                                   "Content-Length is "
-                                                  "required"})
+                                                  "required",
+                                         "request_id": self._rid})
         if length > MAX_BODY_BYTES:
             return self._send_json(413, {"error": "body of %d bytes "
                                          "exceeds the %d byte cap"
-                                         % (length, MAX_BODY_BYTES)})
+                                         % (length, MAX_BODY_BYTES),
+                                         "request_id": self._rid})
         try:
             doc = json.loads(self.rfile.read(length).decode("utf-8"))
             inputs = doc["inputs"]
@@ -124,20 +184,25 @@ class _Handler(BaseHTTPRequestHandler):
             arrays = {str(k): np.asarray(v) for k, v in inputs.items()}
         except (ValueError, KeyError, TypeError) as exc:
             return self._send_json(400, {"error": "bad request body: %s"
-                                         % exc})
+                                         % exc,
+                                         "request_id": self._rid})
         try:
             outs = self.server.engine.predict(arrays,
-                                              deadline_ms=deadline_ms)
+                                              deadline_ms=deadline_ms,
+                                              rid=self._rid)
         except RequestRejected as exc:
             return self._send_json(
                 _REJECT_HTTP.get(exc.status, 503),
-                {"error": str(exc), "status": exc.status})
+                {"error": str(exc), "status": exc.status,
+                 "request_id": self._rid})
         except MXNetError as exc:   # validation: client's fault
-            return self._send_json(400, {"error": str(exc)})
+            return self._send_json(400, {"error": str(exc),
+                                         "request_id": self._rid})
         except Exception as exc:    # compute/engine failure: ours
             logger.exception("predict failed")
             return self._send_json(500, {"error": repr(exc),
-                                         "status": "error"})
+                                         "status": "error",
+                                         "request_id": self._rid})
         self._send_json(200, {
             "outputs": [o.tolist() for o in outs],
             "shapes": [list(o.shape) for o in outs],

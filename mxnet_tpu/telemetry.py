@@ -53,7 +53,8 @@ import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "snapshot", "dumps", "reset",
-           "span", "event", "configure", "configured_dir", "flush",
+           "span", "event", "record_span", "configure", "configured_dir",
+           "flush",
            "write_snapshot", "host_id", "set_host_id", "read_events",
            "to_chrome", "merge", "add_tap", "remove_tap", "swallowed"]
 
@@ -508,6 +509,28 @@ def event(name, **args):
            "mono": time.monotonic(), "pid": os.getpid(),
            "host": host_id(), "tid": threading.get_ident() & 0xFFFFFF,
            "args": args}
+    _tap(rec)
+    _emit(rec)
+
+
+def record_span(name, wall_ts, dur, mono=None, **args):
+    """Append one retrospective complete ("X") span — for work whose
+    start and duration the caller measured itself, reconstructed after
+    the fact (the serving engine emits per-request anatomy at resolve
+    time, not inline — a `span` context manager cannot bracket a
+    request that flows through three threads).
+
+    Linkage convention: correlating ids ride in ``args`` (``rid=`` for
+    a request, ``batch=`` for the micro-batch that served it), so
+    chrome-trace consumers can join ``serving.request`` spans to the
+    ``serving.batch`` spans that carried them. No registry side effect:
+    retrospective callers own their histograms."""
+    if _state["dir"] is None and not _taps:
+        return
+    rec = {"name": name, "ph": "X", "ts": float(wall_ts),
+           "mono": float(mono) if mono is not None else None,
+           "dur": float(dur), "pid": os.getpid(), "host": host_id(),
+           "tid": threading.get_ident() & 0xFFFFFF, "args": args}
     _tap(rec)
     _emit(rec)
 

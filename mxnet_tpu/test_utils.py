@@ -248,7 +248,9 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
         try:
             import jax.numpy as jnp
             tol[np.dtype(jnp.bfloat16)] = 5e-2
-        except Exception:
+        except (ImportError, AttributeError):
+            # no jax / no bfloat16 in this build: fp16/32/64 tolerances
+            # still apply, bf16 arrays simply cannot occur
             pass
     elif isinstance(tol, float):
         tol = {k: tol for k in (np.dtype(np.float16), np.dtype(np.float32),

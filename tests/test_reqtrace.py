@@ -1,0 +1,465 @@
+"""Request anatomy (`mxnet_tpu/serving/reqtrace.py`): trace telescoping,
+SLO burn-rate math, pad-waste accounting, tail classification, the
+report CLI's verdict fixtures, and the serving-latency bench gate."""
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from mxnet_tpu.serving import reqtrace
+from mxnet_tpu.serving.batching import PadLedger
+from mxnet_tpu.serving.reqtrace import (PHASES, RequestTracer, SLOTracker,
+                                        Trace, classify, clean_request_id,
+                                        new_request_id)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+def test_request_ids():
+    a, b = new_request_id(), new_request_id()
+    assert a != b and len(a) == 16
+    assert clean_request_id("abc-123.X:ok") == "abc-123.X:ok"
+    # header injection is stripped; empty/None regenerate
+    assert "\n" not in clean_request_id("evil\nSet-Cookie: x")
+    assert clean_request_id("\n\r ") != ""
+    assert clean_request_id(None)
+    assert len(clean_request_id("x" * 500)) <= 128
+
+
+# ---------------------------------------------------------------------------
+# trace telescoping
+# ---------------------------------------------------------------------------
+
+def _full_trace(rid="r1", t0=100.0):
+    tr = Trace(rid, wall0=0.0)
+    marks = {"enqueued": t0, "picked": t0 + 0.010,
+             "pad_start": t0 + 0.015, "pad_end": t0 + 0.016,
+             "forward_end": t0 + 0.030, "outputs_end": t0 + 0.090,
+             "split_end": t0 + 0.091}
+    for name, t in marks.items():
+        tr.mark(name, t)
+    return tr, t0 + 0.095
+
+
+def test_trace_phases_telescope_exactly():
+    tr, end = _full_trace()
+    phases = tr.phases(end)
+    assert set(phases) == set(PHASES)
+    assert sum(phases.values()) == pytest.approx(end - 100.0, abs=1e-12)
+    assert phases["queue_wait"] == pytest.approx(0.010)
+    assert phases["batch_wait"] == pytest.approx(0.005)
+    assert phases["device_compute"] == pytest.approx(0.060)
+    assert phases["respond"] == pytest.approx(0.004)
+
+
+def test_partial_trace_attributes_remainder_to_stalled_phase():
+    # expired while queued: only 'enqueued' is marked -> pure queue_wait
+    tr = Trace("r2")
+    tr.mark("enqueued", 10.0)
+    assert tr.phases(10.5) == {"queue_wait": pytest.approx(0.5)}
+    # died between pickup and pad: remainder lands in batch_wait
+    tr.mark("picked", 10.1)
+    phases = tr.phases(10.5)
+    assert phases["queue_wait"] == pytest.approx(0.1)
+    assert phases["batch_wait"] == pytest.approx(0.4)
+    with pytest.raises(ValueError):
+        tr.mark("not_a_mark")
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math (deterministic clock)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_burn_rate_math():
+    clk = _Clock()
+    slo = SLOTracker(target_ms=100.0, availability=0.99,
+                     windows=[60, 600], clock=clk)
+    assert slo.error_budget == pytest.approx(0.01)
+    for i in range(95):
+        slo.record(True, 0.010)
+    for i in range(5):
+        slo.record(False)
+    # 5% bad over a 1% budget: burning 5x in both windows
+    assert slo.burn_rate(60) == pytest.approx(5.0)
+    assert slo.burn_rate(600) == pytest.approx(5.0)
+    snap = slo.snapshot()
+    assert snap["good_total"] == 95 and snap["bad_total"] == 5
+    assert snap["burn_rate"]["60"] == pytest.approx(5.0)
+
+
+def test_slo_slow_success_burns_budget():
+    slo = SLOTracker(target_ms=100.0, availability=0.9, windows=[60],
+                     clock=_Clock())
+    slo.record(True, 0.250)   # ok but 2.5x the target: bad
+    slo.record(True, 0.050)
+    assert slo.window_counts(60) == (2, 1)
+    assert slo.burn_rate(60) == pytest.approx(0.5 / 0.1)
+
+
+def test_slo_windows_age_out_independently():
+    clk = _Clock(1000.0)
+    slo = SLOTracker(target_ms=100.0, availability=0.99,
+                     windows=[60, 3600], clock=clk)
+    for _ in range(10):
+        slo.record(False)
+    clk.t += 120.0            # past the short window, inside the long
+    slo.record(True, 0.010)
+    assert slo.window_counts(60) == (1, 0)
+    assert slo.burn_rate(60) == 0.0
+    total, bad = slo.window_counts(3600)
+    assert (total, bad) == (11, 10)
+    assert slo.burn_rate(3600) > 1.0
+
+
+def test_slo_idle_is_not_an_alert_and_validation():
+    slo = SLOTracker(target_ms=50.0, availability=0.999, windows=[60],
+                     clock=_Clock())
+    assert slo.burn_rate(60) == 0.0
+    with pytest.raises(ValueError):
+        SLOTracker(target_ms=0, availability=0.9, windows=[60])
+    with pytest.raises(ValueError):
+        SLOTracker(target_ms=50, availability=1.5, windows=[60])
+    with pytest.raises(ValueError):
+        SLOTracker(target_ms=50, availability=0.9, windows=[])
+
+
+def test_slo_env_defaults(monkeypatch):
+    monkeypatch.setenv("MXNET_SLO_LATENCY_MS", "75")
+    monkeypatch.setenv("MXNET_SLO_AVAILABILITY", "0.95")
+    monkeypatch.setenv("MXNET_SLO_WINDOWS", "30,90")
+    slo = SLOTracker()
+    assert slo.target_ms == 75.0
+    assert slo.availability == 0.95
+    assert slo.windows == (30, 90)
+
+
+# ---------------------------------------------------------------------------
+# pad-waste accounting
+# ---------------------------------------------------------------------------
+
+def test_pad_ledger_per_bucket():
+    led = PadLedger()
+    assert led.waste_ratio() == 0.0
+    assert led.occupancy(4) is None
+    led.note(3, 4)
+    led.note(4, 4)
+    led.note(1, 8)
+    # dispatched rows: 4+4+8=16, real: 3+4+1=8
+    assert led.waste_ratio() == pytest.approx(0.5)
+    assert led.occupancy(4) == pytest.approx(7 / 8.0)
+    assert led.occupancy(8) == pytest.approx(1 / 8.0)
+    snap = led.snapshot()
+    assert snap["waste_ratio"] == pytest.approx(0.5)
+    assert snap["buckets"]["4"] == {"batches": 2, "real_rows": 7,
+                                    "occupancy": 0.875}
+    with pytest.raises(ValueError):
+        led.note(5, 4)
+    with pytest.raises(ValueError):
+        led.note(0, 4)
+    led.reset()
+    assert led.waste_ratio() == 0.0
+
+
+def test_tracer_note_batch_publishes_metrics():
+    from mxnet_tpu import telemetry
+    tr = RequestTracer(window=64)
+    tr.note_batch(2, 4)
+    assert telemetry.get_metric("serving_real_rows_total",
+                                bucket="4").value == 2
+    assert telemetry.get_metric("serving_pad_rows_total",
+                                bucket="4").value == 2
+    assert telemetry.get_metric("serving_pad_waste_ratio").read() \
+        == pytest.approx(0.5)
+    assert telemetry.get_metric("serving_bucket_occupancy",
+                                bucket="4").read() == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# classification: one fixture per verdict class
+# ---------------------------------------------------------------------------
+
+def _shares(**kv):
+    total = sum(kv.values())
+    return {p: kv.get(p, 0.0) / total for p in PHASES}
+
+
+def test_classify_queue_bound():
+    v, hint = classify(_shares(queue_wait=0.5, batch_wait=0.2,
+                               device_compute=0.3))
+    assert v == "queue-bound"
+    assert "MXNET_SERVING_REPLICAS" in hint
+    assert "MXNET_SERVING_MAX_DELAY_MS" in hint
+
+
+def test_classify_compute_bound():
+    v, hint = classify(_shares(device_compute=0.7, dispatch=0.1,
+                               queue_wait=0.2), pad_waste=0.05)
+    assert v == "compute-bound"
+    assert "replicas" in hint
+
+
+def test_classify_padding_bound():
+    shares = _shares(device_compute=0.7, dispatch=0.1, queue_wait=0.2)
+    v, hint = classify(shares, pad_waste=0.6)
+    assert v == "padding-bound"
+    assert "bucket ladder" in hint or "bucket_sizes" in hint
+    # padding only matters when the tail actually computes
+    assert classify(_shares(queue_wait=0.9, device_compute=0.1),
+                    pad_waste=0.6)[0] == "queue-bound"
+
+
+def test_classify_shed_heavy_and_unknown():
+    v, hint = classify(_shares(device_compute=1.0), shed_fraction=0.2)
+    assert v == "shed-heavy"
+    assert "MXNET_SERVING_QUEUE_DEPTH" in hint
+    assert classify({})[0] == "unknown"
+    assert classify(_shares(device_compute=1.0),
+                    shed_fraction=0.01)[0] == "compute-bound"
+
+
+# ---------------------------------------------------------------------------
+# tracer attribution + slow ring
+# ---------------------------------------------------------------------------
+
+def _feed(tracer, rid, total, queue_frac=0.1, t0=0.0, status="ok"):
+    """Record one synthetic request: queue_frac of `total` in the
+    queue, the rest split across the compute-side phases."""
+    tr = Trace(rid, wall0=t0)
+    q = total * queue_frac
+    rest = total - q
+    tr.mark("enqueued", t0)
+    tr.mark("picked", t0 + q * 0.7)
+    tr.mark("pad_start", t0 + q)
+    tr.mark("pad_end", t0 + q + rest * 0.05)
+    tr.mark("forward_end", t0 + q + rest * 0.15)
+    tr.mark("outputs_end", t0 + q + rest * 0.9)
+    tr.mark("split_end", t0 + q + rest * 0.95)
+    tr.bucket, tr.batch = 4, 1
+    return tracer.record(tr, t0 + total, status=status)
+
+
+def test_attribution_contrasts_p50_and_tail():
+    tracer = RequestTracer(window=256, slow_keep=4)
+    # bulk: fast compute-ish requests; tail: queue-dominated stragglers
+    for i in range(100):
+        _feed(tracer, "fast-%d" % i, total=0.010, queue_frac=0.1)
+    for i in range(2):
+        _feed(tracer, "slow-%d" % i, total=0.500, queue_frac=0.9)
+    att = tracer.attribution()
+    assert att["requests"] == 102
+    assert att["latency"]["p99"] > att["latency"]["p50"]
+    qtail = att["p99_shares"]["queue_wait"] + att["p99_shares"]["batch_wait"]
+    qhead = att["p50_shares"]["queue_wait"] + att["p50_shares"]["batch_wait"]
+    assert qtail > 0.8 > qhead
+    # the slow ring kept the stragglers, slowest first
+    slow = tracer.slowest()
+    assert [r["rid"] for r in slow[:2]] == ["slow-0", "slow-1"] \
+        or [r["rid"] for r in slow[:2]] == ["slow-1", "slow-0"]
+    assert slow[0]["total"] == pytest.approx(0.5)
+    snap = tracer.snapshot()
+    assert snap["verdict"] == "queue-bound"
+    # record() returns the folded record and phases tile the total
+    rec = _feed(tracer, "one", total=0.020)
+    assert sum(rec["phases"].values()) == pytest.approx(rec["total"])
+
+
+def test_tracer_counts_rejects_toward_shed_fraction():
+    tracer = RequestTracer(window=64)
+    for i in range(9):
+        _feed(tracer, "ok-%d" % i, total=0.010)
+    for _ in range(6):
+        tracer.note_reject("shed")
+    att = tracer.attribution()
+    assert att["shed_fraction"] == pytest.approx(6 / 15.0)
+    v, _ = classify(att["p99_shares"], shed_fraction=att["shed_fraction"])
+    assert v == "shed-heavy"
+
+
+# ---------------------------------------------------------------------------
+# report CLI: verdict fixtures, one per class
+# ---------------------------------------------------------------------------
+
+def _snapshot_doc(p99_shares, shed_fraction=0.0, waste=0.0):
+    return {"host": 0, "pid": 1, "updated": 123.0, "requests": 100,
+            "counts": {"ok": 100}, "shed_fraction": shed_fraction,
+            "latency": {"p50": 0.002, "p95": 0.008, "p99": 0.02,
+                        "count": 100, "max": 0.03},
+            "p50_shares": _shares(device_compute=1.0),
+            "p99_shares": p99_shares,
+            "pad": {"waste_ratio": waste, "buckets": {}},
+            "slowest": [{"rid": "slow-1", "total": 0.03,
+                         "phases": {"queue_wait": 0.02,
+                                    "device_compute": 0.01}}]}
+
+
+@pytest.mark.parametrize("doc,verdict", [
+    (_snapshot_doc(_shares(queue_wait=0.7, device_compute=0.3)),
+     "queue-bound"),
+    (_snapshot_doc(_shares(device_compute=0.8, dispatch=0.2)),
+     "compute-bound"),
+    (_snapshot_doc(_shares(device_compute=0.8, dispatch=0.2), waste=0.5),
+     "padding-bound"),
+    (_snapshot_doc(_shares(device_compute=1.0), shed_fraction=0.3),
+     "shed-heavy"),
+])
+def test_report_cli_verdict_fixtures(tmp_path, doc, verdict):
+    path = tmp_path / "reqtrace_host0_pid1.json"
+    path.write_text(json.dumps(doc))
+    out = io.StringIO()
+    assert reqtrace.report(str(path), out=out) == 0
+    text = out.getvalue()
+    assert "verdict: %s" % verdict in text
+    machine = json.loads(text.strip().splitlines()[-1])
+    assert machine["metric"] == "reqtrace_report"
+    assert machine["verdict"] == verdict
+    assert "slow exemplar slow-1" in text
+
+
+def test_report_names_dominant_p99_phase_on_queue_delay(tmp_path):
+    """THE acceptance fixture: a synthetic queue-delay tail must be
+    attributed to queue_wait by name."""
+    doc = _snapshot_doc(_shares(queue_wait=0.62, batch_wait=0.2,
+                                device_compute=0.18))
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(doc))
+    out = io.StringIO()
+    assert reqtrace.report(str(path), out=out) == 0
+    text = out.getvalue()
+    assert "dominant p99 phase: queue_wait" in text
+    machine = json.loads(text.strip().splitlines()[-1])
+    assert machine["dominant_p99_phase"] == "queue_wait"
+    assert machine["verdict"] == "queue-bound"
+
+
+def test_report_merges_host_snapshot_dir(tmp_path):
+    for host, shares in ((0, _shares(queue_wait=1.0)),
+                         (1, _shares(queue_wait=1.0))):
+        doc = _snapshot_doc(shares)
+        doc["host"] = host
+        (tmp_path / ("reqtrace_host%d_pid1.json" % host)).write_text(
+            json.dumps(doc))
+    out = io.StringIO()
+    assert reqtrace.report(str(tmp_path), out=out) == 0
+    assert "2 host snapshot(s)" in out.getvalue()
+    assert "verdict: queue-bound" in out.getvalue()
+
+
+def test_report_no_data_exits_1(tmp_path):
+    out = io.StringIO()
+    tracer_backup = reqtrace.tracer
+    try:
+        reqtrace.tracer = RequestTracer(window=16)
+        assert reqtrace.report(out=out) == 1
+        assert "unknown" in out.getvalue()
+    finally:
+        reqtrace.tracer = tracer_backup
+
+
+def test_report_main_cli(tmp_path, capsys):
+    doc = _snapshot_doc(_shares(device_compute=1.0))
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(doc))
+    assert reqtrace.main(["report", str(path), "--json"]) == 0
+    line = capsys.readouterr().out.strip()
+    assert json.loads(line)["verdict"] == "compute-bound"
+
+
+def test_write_host_snapshot_roundtrip(tmp_path):
+    tracer = RequestTracer(window=32)
+    _feed(tracer, "r1", total=0.050)
+    path = tracer.write_host_snapshot(dir=str(tmp_path))
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["requests"] == 1 and doc["verdict"] != "unknown"
+    # unconfigured + no dir -> no-op
+    empty = RequestTracer(window=16)
+    assert empty.write_host_snapshot(dir=str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# serving-latency bench gate (lower is better) + repo_gate wiring
+# ---------------------------------------------------------------------------
+
+def _bench_gate():
+    if TOOLS not in sys.path:
+        sys.path.insert(0, TOOLS)
+    import bench_gate
+    return bench_gate
+
+
+def test_serving_gate_lower_is_better(tmp_path):
+    bench_gate = _bench_gate()
+    assert bench_gate.lower_is_better(bench_gate.SERVE_METRIC)
+    assert not bench_gate.lower_is_better("serving_closed_rps")
+    hist = tmp_path / "BENCH_serve.json"
+    hist.write_text(json.dumps(
+        [{"metric": bench_gate.SERVE_METRIC, "value": 20.0},
+         {"metric": bench_gate.SERVE_METRIC, "value": 10.0}]))
+    out = io.StringIO()
+    # best history value is the MIN (10); +10% ceiling = 11
+    ok = [{"metric": bench_gate.SERVE_METRIC, "value": 10.9}]
+    bad = [{"metric": bench_gate.SERVE_METRIC, "value": 12.0,
+            "phases": {"queue_wait": 0.8}}]
+    assert bench_gate.gate_records(ok, history_dir=str(tmp_path),
+                                   metric=bench_gate.SERVE_METRIC,
+                                   out=out) == 0
+    assert bench_gate.gate_records(bad, history_dir=str(tmp_path),
+                                   metric=bench_gate.SERVE_METRIC,
+                                   out=out) == 1
+    lines = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    fail = [ln for ln in lines if ln.get("status") == "fail"]
+    assert fail and "ceiling" in fail[0]["detail"]
+    # the anatomy delta line rides along on the regression
+    assert any(ln.get("metric") == "bench_gate_phases" for ln in lines)
+
+
+def test_serving_gate_improvement_passes(tmp_path):
+    bench_gate = _bench_gate()
+    hist = tmp_path / "BENCH_serve.json"
+    hist.write_text(json.dumps(
+        [{"metric": bench_gate.SERVE_METRIC, "value": 10.0}]))
+    better = [{"metric": bench_gate.SERVE_METRIC, "value": 5.0}]
+    assert bench_gate.gate_records(better, history_dir=str(tmp_path),
+                                   metric=bench_gate.SERVE_METRIC,
+                                   out=io.StringIO()) == 0
+
+
+def test_repo_gate_runs_serving_gate(tmp_path, capfd):
+    """repo_gate --bench gates the serving p99 alongside mxanalyze when
+    the run carries serving records (shared exit-code + JSON lines).
+    capfd, not capsys: bench_gate binds ``out=sys.stdout`` at import."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools import repo_gate
+    bench_gate = _bench_gate()
+    run = tmp_path / "run.jsonl"
+    run.write_text("\n".join([
+        json.dumps({"metric": bench_gate.SERVE_METRIC, "value": 1e9}),
+        json.dumps({"metric": "serving_closed_rps", "value": 1.0}),
+    ]))
+    rc = repo_gate.main(["--bench", str(run)])
+    out = capfd.readouterr().out
+    # serving history exists in the repo only once BENCH rounds record
+    # it; either way the serving gate RAN and said so on its own line
+    gate_lines = [json.loads(ln) for ln in out.splitlines()
+                  if ln.startswith("{") and '"bench_gate"' in ln]
+    serve_lines = [ln for ln in gate_lines
+                   if bench_gate.SERVE_METRIC in ln.get("detail", "")]
+    assert serve_lines, out
+    if serve_lines[0]["status"] == "fail":
+        assert rc == 1

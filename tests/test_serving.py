@@ -16,7 +16,8 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import chaos, telemetry, xla_stats
 from mxnet_tpu.serving import (EngineConfig, InferenceEngine,
-                               RequestRejected, batching, serve)
+                               RequestRejected, batching, reqtrace,
+                               serve)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -164,9 +165,11 @@ def test_request_validation(make_engine):
 
 def test_concurrent_load_no_cold_compiles(make_engine, params):
     """THE acceptance test: >= 8 client threads, mixed request sizes,
-    every response correct, and the engine performs ZERO compiles after
+    every response correct, the engine performs ZERO compiles after
     warm-up (all signatures bucket-bounded and pre-compiled) while the
-    cache-hit counter does the serving."""
+    cache-hit counter does the serving — and every completed request's
+    phase anatomy tiles its wall latency (sum of spans within 10%)."""
+    reqtrace.reset()
     eng = make_engine(max_batch_size=8, max_batch_delay_ms=2.0,
                       max_queue=256)
     hits_before = xla_stats.compile_counts()["cache_hits"]
@@ -211,6 +214,27 @@ def test_concurrent_load_no_cold_compiles(make_engine, params):
     # batching actually batched: fewer dispatches than requests served
     batches = batch_count() - batches_before
     assert 0 < batches < n_threads * per_thread
+
+    # request anatomy: every completed request decomposed into the full
+    # taxonomy, and the phase spans tile its measured wall latency
+    # (telescoping boundaries -> within 10% is the loose public bound)
+    recs = [r for r in reqtrace.tracer.records() if r["status"] == "ok"]
+    assert len(recs) >= n_threads * per_thread
+    for rec in recs:
+        assert set(rec["phases"]) == set(reqtrace.PHASES)
+        assert abs(sum(rec["phases"].values()) - rec["total"]) \
+            <= 0.1 * max(rec["total"], 1e-9), rec
+        assert rec["bucket"] in eng.buckets
+        assert rec["batch"] is not None
+    # pad accounting saw every dispatched batch
+    pad = reqtrace.tracer.pad.snapshot()
+    assert sum(b["batches"] for b in pad["buckets"].values()) \
+        >= batches
+    assert 0.0 <= pad["waste_ratio"] < 1.0
+    # SLO: everything completed well under the default 250ms target
+    slo = eng.stats()["slo"]
+    assert slo["bad_total"] == 0
+    assert slo["good_total"] >= n_threads * per_thread
 
 
 def test_deadline_expired_at_submit(make_engine):
@@ -339,6 +363,157 @@ def test_shutdown_without_drain_fails_queued(make_engine):
     # whatever was already in flight may finish; the rest got "closed"
     assert "closed" in statuses
     assert statuses <= {"ok", "closed"}
+
+
+# ---------------------------------------------------------------------------
+# request anatomy: tail attribution + trace propagation
+# ---------------------------------------------------------------------------
+
+def test_report_names_queue_delay_under_load(make_engine):
+    """Synthetic queue-delay fixture: a worker stalled by chaos makes
+    requests tail in queue_wait/batch_wait, and the report CLI names
+    that dominant p99 phase and says queue-bound."""
+    import io
+    reqtrace.reset()
+    eng = make_engine(max_batch_size=2, max_batch_delay_ms=0.0,
+                      max_queue=64)
+    # one warm request so the head of the window is fast
+    for i in range(10):
+        eng.predict({"data": _x(1, seed=i)}, timeout=30)
+    # the stall: each batch sleeps 50ms, so later submissions queue
+    chaos.arm("serving.slow_request", times=10, value="0.05")
+    futs = [eng.submit({"data": _x(1, seed=100 + i)}) for i in range(8)]
+    for f in futs:
+        f.result(timeout=60)
+    chaos.clear("serving.slow_request")
+    out = io.StringIO()
+    assert reqtrace.report(out=out) == 0
+    text = out.getvalue()
+    machine = json.loads(text.strip().splitlines()[-1])
+    assert machine["verdict"] == "queue-bound", text
+    assert machine["dominant_p99_phase"] in ("queue_wait", "batch_wait")
+    assert ("dominant p99 phase: %s" % machine["dominant_p99_phase"]) \
+        in text
+    # zero cold compiles even through the chaos-stalled tail
+    assert eng.cold_compiles() == 0
+
+
+def test_engine_propagates_rid_and_rejections_carry_it(make_engine):
+    eng = make_engine(max_batch_size=2, max_batch_delay_ms=0.0)
+    reqtrace.reset()
+    eng.predict({"data": _x(1)}, timeout=30, rid="my-trace-1")
+    recs = reqtrace.tracer.records()
+    assert [r["rid"] for r in recs] == ["my-trace-1"]
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit({"data": _x(1)}, deadline_ms=-5, rid="dead-1")
+    assert ei.value.rid == "dead-1"
+    assert reqtrace.tracer.counts().get("expired", 0) >= 1
+
+
+def test_http_trace_propagation_end_to_end(make_engine, tmp_path):
+    """THE propagation test: X-Request-Id in -> the engine's
+    serving.request span lands in the telemetry JSONL with that id,
+    the serving.batch span links it in args.rids, and the response
+    echoes the header back."""
+    telemetry.configure(str(tmp_path))
+    try:
+        eng = make_engine(max_batch_size=4, max_batch_delay_ms=1.0)
+        srv = serve(eng, port=0)
+        rid = "e2e-trace-42"
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            body = json.dumps({"inputs": {"data": _x(2).tolist()}})
+            conn.request("POST", "/predict", body,
+                         {"Content-Type": "application/json",
+                          "X-Request-Id": rid})
+            resp = conn.getresponse()
+            raw = resp.read()
+            assert resp.status == 200, raw
+            assert resp.getheader("X-Request-Id") == rid
+            conn.close()
+
+            # error responses carry the trace id too
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request("POST", "/predict",
+                         json.dumps({"inputs": {"datum": [[0.0]]}}),
+                         {"Content-Type": "application/json",
+                          "X-Request-Id": "bad-input-7"})
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+            assert resp.status == 400
+            assert doc["request_id"] == "bad-input-7"
+            conn.close()
+        finally:
+            srv.stop()
+        telemetry.flush()
+        events = []
+        for fn in os.listdir(str(tmp_path)):
+            if fn.endswith(".jsonl"):
+                events.extend(telemetry.read_events(
+                    os.path.join(str(tmp_path), fn)))
+        req_spans = [e for e in events if e["name"] == "serving.request"
+                     and e["args"].get("rid") == rid]
+        assert len(req_spans) == 1, [e["name"] for e in events][:20]
+        span = req_spans[0]
+        assert span["ph"] == "X"
+        assert span["args"]["status"] == "ok"
+        phases = span["args"]["phases"]
+        assert set(phases) == set(reqtrace.PHASES)
+        assert abs(sum(phases.values()) - span["dur"]) \
+            <= 0.1 * span["dur"] + 1e-6
+        batch_spans = [e for e in events if e["name"] == "serving.batch"
+                       and rid in (e["args"].get("rids") or [])]
+        assert len(batch_spans) == 1
+        assert batch_spans[0]["args"]["batch"] == span["args"]["batch"]
+        # per-route metrics counted both requests
+        m = telemetry.get_metric("serving_http_requests_total",
+                                 route="/predict", code="200")
+        assert m is not None and m.value >= 1
+        m = telemetry.get_metric("serving_http_requests_total",
+                                 route="/predict", code="400")
+        assert m is not None and m.value >= 1
+    finally:
+        telemetry.configure(None)
+
+
+def test_healthz_reports_saturation(make_engine):
+    eng = make_engine(max_batch_size=2)
+    srv = serve(eng, port=0)
+    try:
+        code, _, raw = _http(srv.port, "GET", "/healthz")
+        doc = json.loads(raw)
+        assert code == 200
+        # the load-balancer saturation triple: queue depth, in-flight,
+        # SLO burn rate per window
+        assert "queue_depth" in doc and "pending" in doc
+        assert set(doc["slo"]["burn_rate"]) \
+            == {str(w) for w in eng._slo.windows}
+        assert doc["slo"]["target_ms"] == eng._slo.target_ms
+    finally:
+        srv.stop()
+
+
+def test_metrics_exposes_anatomy_series(make_engine):
+    eng = make_engine(max_batch_size=4, max_batch_delay_ms=0.0)
+    srv = serve(eng, port=0)
+    try:
+        eng.predict({"data": _x(3)}, timeout=30)
+        code, _, raw = _http(srv.port, "GET", "/metrics")
+        text = raw.decode()
+        assert code == 200
+        for series in ("serving_req_phase_seconds",
+                       "serving_pad_waste_ratio",
+                       "serving_bucket_occupancy",
+                       "serving_slo_burn_rate",
+                       "serving_slo_target_ms",
+                       "serving_http_seconds"):
+            assert series in text, series
+        assert 'phase="queue_wait"' in text
+        assert 'phase="device_compute"' in text
+    finally:
+        srv.stop()
 
 
 # ---------------------------------------------------------------------------

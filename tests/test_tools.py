@@ -286,3 +286,10 @@ def test_serve_bench_closed_loop(tmp_path):
     assert metrics["serving_cold_compiles"]["value"] == 0
     # 2 clients x 3 requests, none rejected in an unloaded engine
     assert "serving_closed_shed_total" not in metrics
+    # the p99 line carries the request anatomy (phase shares + verdict)
+    # so a latency regression gates pre-diagnosed, TRAIN-style
+    p99 = metrics["serving_closed_p99_ms"]
+    assert p99.get("verdict")
+    assert p99.get("phases") and abs(sum(p99["phases"].values()) - 1.0) \
+        < 0.01
+    assert metrics["serving_closed_pad_waste_ratio"]["value"] >= 0.0

@@ -1,7 +1,16 @@
 #!/usr/bin/env python
 """Benchmark regression gate: compare a fresh bench run against the
 best recorded history and fail on a >10% regression of the TRAIN
-north-star metric.
+north-star metric (or, for the serving lane, the p99 latency
+headline — see below).
+
+Direction-aware: throughput-style metrics regress DOWN, latency-style
+metrics (names ending in ``_ms`` / ``_seconds``) regress UP; "best"
+history and the pass bound flip accordingly. ``bench.py --serve``
+gates both ``serving_closed_rps`` (higher is better) and
+``serving_closed_p99_ms`` (lower is better), and a p99 regression
+prints the request-anatomy phase-share delta line the same way a TRAIN
+regression prints the step-time one.
 
 History sources (all optional, merged):
   - ``BENCH_r*.json`` / ``BENCH_EXTRA.json`` round records — both the
@@ -37,7 +46,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TRAIN_METRIC = "resnet50_train_imgs_per_sec_bf16_bs128"
 INFER_METRIC = "resnet50_infer_imgs_per_sec_bs32"
+SERVE_METRIC = "serving_closed_p99_ms"
 DEFAULT_THRESHOLD = 0.10
+
+
+def lower_is_better(metric):
+    """Latency-style metrics regress UP: the gate direction, the
+    history "best", and the pass bound all flip for them."""
+    return metric.endswith("_ms") or metric.endswith("_seconds")
+
+
+def _improves(new, old, lower):
+    return new < old if lower else new > old
 
 
 def parse_lines(lines):
@@ -78,7 +98,8 @@ def load_history(history_dir=None, with_phases=False):
         ph = (rec or {}).get("phases")
         if isinstance(ph, dict):
             prev = phases.get((metric, source))
-            if prev is None or float(value) > prev[0]:
+            if prev is None or _improves(float(value), prev[0],
+                                         lower_is_better(metric)):
                 phases[(metric, source)] = (float(value), ph)
 
     paths = sorted(glob.glob(os.path.join(history_dir, "BENCH_*.json")))
@@ -113,13 +134,15 @@ def load_history(history_dir=None, with_phases=False):
         except (OSError, ValueError):
             pass
     # dedupe per (metric, source): keep the best value each source saw
+    # (max for throughput, min for latency), best-first overall
     for metric, vals in out.items():
+        lower = lower_is_better(metric)
         best = {}
         for v, src in vals:
-            if src not in best or v > best[src]:
+            if src not in best or _improves(v, best[src], lower):
                 best[src] = v
         out[metric] = sorted(((v, s) for s, v in best.items()),
-                             reverse=True)
+                             reverse=not lower)
     if with_phases:
         return out, {k: ph for k, (_v, ph) in phases.items()}
     return out
@@ -172,8 +195,11 @@ def _phase_delta_line(records, metric, best_src, phase_hist, out):
 
 
 def gate_records(records, history_dir=None, metric=None,
-                 threshold=DEFAULT_THRESHOLD, strict=False, out=sys.stdout):
-    """Gate already-parsed run records; returns the process exit code."""
+                 threshold=DEFAULT_THRESHOLD, strict=False, out=None):
+    """Gate already-parsed run records; returns the process exit code.
+    ``out`` defaults to the CURRENT sys.stdout (resolved per call, so
+    redirected/captured stdout works)."""
+    out = out if out is not None else sys.stdout
     history, phase_hist = load_history(history_dir, with_phases=True)
 
     def say(status, detail, **extra):
@@ -205,12 +231,18 @@ def gate_records(records, history_dir=None, metric=None,
             % (metric, history_dir or REPO), value=value)
         return 1 if strict else 0
     best, best_src = hist[0]
-    floor = best * (1.0 - threshold)
+    lower = lower_is_better(metric)
+    if lower:
+        bound = best * (1.0 + threshold)   # latency ceiling
+        ok, word = value <= bound, "ceiling"
+    else:
+        bound = best * (1.0 - threshold)   # throughput floor
+        ok, word = value >= bound, "floor"
 
-    if value >= floor:
-        say("pass", "%s=%.2f vs best %.2f (%s); floor %.2f"
-            % (metric, value, best, best_src, floor),
-            value=value, best=best, floor=floor)
+    if ok:
+        say("pass", "%s=%.2f vs best %.2f (%s); %s %.2f"
+            % (metric, value, best, best_src, word, bound),
+            value=value, best=best, floor=bound)
         return 0
 
     platform = _run_platform(records)
@@ -218,16 +250,17 @@ def gate_records(records, history_dir=None, metric=None,
         # recorded history comes from accelerator rounds; a CPU fallback
         # run regressing against it is an environment mismatch, not a
         # code regression
-        say("skip", "%s=%.2f is below floor %.2f but the run executed "
+        say("skip", "%s=%.2f is past %s %.2f but the run executed "
             "on cpu while history was recorded on an accelerator; use "
-            "--strict to fail anyway" % (metric, value, floor),
-            value=value, best=best, floor=floor)
+            "--strict to fail anyway" % (metric, value, word, bound),
+            value=value, best=best, floor=bound)
         return 0
 
-    say("fail", "%s regressed: %.2f < floor %.2f (best %.2f from %s, "
-        "threshold %.0f%%)" % (metric, value, floor, best, best_src,
+    say("fail", "%s regressed: %.2f %s %s %.2f (best %.2f from %s, "
+        "threshold %.0f%%)" % (metric, value, ">" if lower else "<",
+                               word, bound, best, best_src,
                                threshold * 100),
-        value=value, best=best, floor=floor)
+        value=value, best=best, floor=bound)
     _phase_delta_line(records, metric, best_src, phase_hist, out)
     return 1
 

@@ -8,14 +8,19 @@ Runs the two gates that share exit-code conventions (0 = pass,
   ``tools/mxanalyze/baseline.json`` — a NEW finding of any rule
   (jit-purity, retrace-hazard, lock-discipline, swallowed-exception,
   env-var-drift) fails the gate the same way a perf regression does;
-- ``tools/bench_gate.py`` over a bench run file, when one is given.
+- ``tools/bench_gate.py`` over a bench run file, when one is given —
+  the TRAIN/INFER headline as before, PLUS the serving-latency gate
+  (lower-is-better ``serving_closed_p99_ms``) whenever the run carries
+  serving records, so ``bench.py --serve`` output gates its tail
+  latency through the same entry point.
 
 Usage:
     python tools/repo_gate.py                     # analysis only
     python tools/repo_gate.py --bench run.jsonl   # analysis + perf
     python bench.py | python tools/repo_gate.py --bench -
 
-Exit status: 0 when every gate passed, 1 when any failed.
+Exit status: 0 when every gate passed, 1 when any failed. Every gate
+emits its own BENCH-style one-line JSON summary.
 """
 from __future__ import annotations
 
@@ -46,10 +51,21 @@ def main(argv=None):
     if args.bench is not None:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         import bench_gate
-        bench_args = [args.bench]
+        if args.bench == "-":
+            lines = sys.stdin.read().splitlines()
+        else:
+            with open(args.bench, "r", encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        records = bench_gate.parse_lines(lines)
+        kwargs = {}
         if args.threshold is not None:
-            bench_args += ["--threshold", str(args.threshold)]
-        rc = max(rc, bench_gate.main(bench_args))
+            kwargs["threshold"] = args.threshold
+        rc = max(rc, bench_gate.gate_records(records, **kwargs))
+        if any(rec.get("metric") == bench_gate.SERVE_METRIC
+               for rec in records):
+            # a serving run also gates its p99 tail (lower is better)
+            rc = max(rc, bench_gate.gate_records(
+                records, metric=bench_gate.SERVE_METRIC, **kwargs))
 
     return rc
 

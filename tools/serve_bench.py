@@ -17,7 +17,15 @@ Reports throughput + p50/p95/p99 and writes BENCH-style JSON metric
 lines ({"metric", "value", "unit", ...}) — the same shape bench.py
 emits, so ``python bench.py --serve`` embeds these records and
 ``tools/bench_gate.py`` can gate them (``--metric
-serving_closed_rps``).
+serving_closed_rps``, and the lower-is-better p99 latency gate on
+``serving_closed_p99_ms``).
+
+Every generated request carries a trace id (``rid=`` into the engine,
+``X-Request-Id`` over HTTP), so a bench run's tail is attributable:
+the in-process paths reset `serving.reqtrace` per loop and attach the
+p99 phase-share breakdown (+ verdict) to the p99 metric line — the
+serving analog of the TRAIN record's ``"phases"`` field that
+`bench_gate` prints as a delta on regression.
 
 Default target is a built-in small MLP engine (CPU-friendly, no files);
 point it at an exported model with ``--symbol/--params/--input`` or at
@@ -138,7 +146,9 @@ def run_closed(submit_and_wait, clients, requests_per_client, sizes,
                make_input):
     """Closed loop: ``clients`` threads each issue
     ``requests_per_client`` blocking requests of rotating ``sizes``.
-    ``submit_and_wait(inputs) -> rows`` raises on rejection/error."""
+    ``submit_and_wait(inputs, rid) -> rows`` raises on
+    rejection/error; ``rid`` is the per-request trace id the submitter
+    must propagate (engine ``rid=`` / HTTP ``X-Request-Id``)."""
     tally = _Tally()
 
     def client(cid):
@@ -148,7 +158,7 @@ def run_closed(submit_and_wait, clients, requests_per_client, sizes,
             inputs = make_input(n, rng)
             t0 = time.monotonic()
             try:
-                rows = submit_and_wait(inputs)
+                rows = submit_and_wait(inputs, "bench-c%d-%d" % (cid, i))
             except Exception as exc:   # noqa: BLE001 - tallied
                 tally.fail(_status_of(exc))
                 continue
@@ -186,7 +196,7 @@ def run_open(engine, qps, seconds, sizes, make_input):
         n = sizes[i % len(sizes)]
         sent = time.monotonic()
         try:
-            fut = engine.submit(make_input(n, rng))
+            fut = engine.submit(make_input(n, rng), rid="bench-o%d" % i)
         except RequestRejected as exc:
             tally.fail(exc.status)
         else:
@@ -213,16 +223,18 @@ def http_submit_and_wait(host, port, input_name, timeout=30):
     import http.client
     local = threading.local()
 
-    def call(inputs):
+    def call(inputs, rid=None):
         conn = getattr(local, "conn", None)
         if conn is None:
             conn = http.client.HTTPConnection(host, port, timeout=timeout)
             local.conn = conn
         body = json.dumps({"inputs": {k: v.tolist()
                                       for k, v in inputs.items()}})
+        headers = {"Content-Type": "application/json"}
+        if rid:
+            headers["X-Request-Id"] = rid
         try:
-            conn.request("POST", "/predict", body,
-                         {"Content-Type": "application/json"})
+            conn.request("POST", "/predict", body, headers)
             resp = conn.getresponse()
             doc = json.loads(resp.read())
         except Exception:
@@ -237,6 +249,28 @@ def http_submit_and_wait(host, port, input_name, timeout=30):
     return call
 
 
+def _attach_anatomy(records, mode):
+    """Fold the reqtrace window's tail attribution into this loop's
+    records: the p99 metric line carries the p99 phase shares + verdict
+    (the serving analog of the TRAIN record's ``"phases"`` field, so a
+    p99 regression gates pre-diagnosed), plus a pad-waste line."""
+    from mxnet_tpu.serving import reqtrace
+    att = reqtrace.tracer.attribution()
+    if not att["requests"]:
+        return
+    verdict, _hint = reqtrace.classify(
+        att["p99_shares"], shed_fraction=att["shed_fraction"],
+        pad_waste=att["pad"].get("waste_ratio"))
+    for rec in records:
+        if rec.get("metric") == "serving_%s_p99_ms" % mode:
+            rec["phases"] = {k: round(v, 4)
+                             for k, v in att["p99_shares"].items()}
+            rec["verdict"] = verdict
+    records.append({"metric": "serving_%s_pad_waste_ratio" % mode,
+                    "value": round(att["pad"].get("waste_ratio", 0.0), 4),
+                    "unit": "ratio", "mode": mode})
+
+
 def bench_records(clients=8, requests_per_client=25, qps=150.0,
                   seconds=2.0, sizes=(1, 2, 3, 5), config=None,
                   mode="both", engine_factory=None):
@@ -244,6 +278,7 @@ def bench_records(clients=8, requests_per_client=25, qps=150.0,
     non-URL branch both land here): closed and/or open loop against
     ``engine_factory()`` (default: the demo engine); returns the metric
     records (engine is shut down)."""
+    from mxnet_tpu.serving import reqtrace
     make = engine_factory or (lambda: build_demo_engine(config=config))
     engine, name, shape = make()
     records = [{"metric": "serving_warmup_compiles",
@@ -253,20 +288,26 @@ def bench_records(clients=8, requests_per_client=25, qps=150.0,
     def make_input(n, rng):
         return {name: rng.rand(n, *shape).astype(np.float32)}
 
-    def submit_and_wait(inputs):
-        engine.predict(inputs, timeout=30)
+    def submit_and_wait(inputs, rid=None):
+        engine.predict(inputs, timeout=30, rid=rid)
         return len(inputs[name])
 
     try:
         if mode in ("closed", "both"):
+            reqtrace.reset()   # this loop's window, not warmup's
             tally, elapsed = run_closed(submit_and_wait, clients,
                                         requests_per_client, list(sizes),
                                         make_input)
-            records.extend(tally.records("closed", elapsed))
+            recs = tally.records("closed", elapsed)
+            _attach_anatomy(recs, "closed")
+            records.extend(recs)
         if mode in ("open", "both"):
+            reqtrace.reset()
             tally, elapsed = run_open(engine, qps, seconds, list(sizes),
                                       make_input)
-            records.extend(tally.records("open", elapsed))
+            recs = tally.records("open", elapsed)
+            _attach_anatomy(recs, "open")
+            records.extend(recs)
         records.append({"metric": "serving_cold_compiles",
                         "value": engine.cold_compiles(),
                         "unit": "compiles"})
