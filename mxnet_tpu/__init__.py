@@ -53,6 +53,7 @@ from . import gluon
 from . import image
 from . import profiler
 from . import xla_stats  # compile accounting / memory ledger / MFU / flight recorder
+from . import compiled  # the ONE compiled-program layer (cache/warmup/donation/policy)
 from . import xplane
 from . import visualization
 from .visualization import print_summary

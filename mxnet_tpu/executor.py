@@ -229,12 +229,14 @@ class Executor:
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
         self._eval_fn = _build_eval(symbol, ctx)
-        # compile-accounted jits (xla_stats): cache hit/miss counters,
-        # compile spans, retrace explanations, per-executable FLOPs.
-        # Lineage = the Symbol: executors rebound over one graph
-        # (reshape/bucketing) diff as retraces; unrelated models don't.
-        from . import xla_stats
-        self._jit_fwd = xla_stats.tracked_jit(
+        # CompiledPrograms (mxnet_tpu/compiled.py): one shared layer for
+        # the signature cache, AOT warmup, donation, and compile
+        # accounting (counters, retrace explanations, per-executable
+        # FLOPs land in xla_stats). Lineage = the Symbol: executors
+        # rebound over one graph (reshape/bucketing) diff as retraces;
+        # unrelated models don't.
+        from . import compiled as compiled_mod
+        self._jit_fwd = compiled_mod.tracked_jit(
             self._eval_fn, "executor.forward", static_argnums=(3,),
             lineage=id(symbol))
         if shardings:
@@ -247,7 +249,7 @@ class Executor:
             self._repl_sharding = None
         self._grad_names = [n for n in self._arg_names
                             if grad_req.get(n, "null") != "null"]
-        self._jit_fwd_bwd = xla_stats.tracked_jit(
+        self._jit_fwd_bwd = compiled_mod.tracked_jit(
             self._fwd_bwd_impl, "executor.forward_backward",
             lineage=id(symbol))
         self._grouped = None
@@ -428,8 +430,8 @@ class Executor:
             grad_args, other_args, aux_vals, key, heads)
         if profiler.aggregate_enabled():
             profiler.finish_timed("_executor_forward_backward", t0, outs)
-        from . import xla_stats
-        if isinstance(self._jit_fwd_bwd, xla_stats.TrackedJit):
+        from . import compiled as compiled_mod, xla_stats
+        if isinstance(self._jit_fwd_bwd, compiled_mod.CompiledProgram):
             # the unfused train path: one fwd+bwd dispatch == one batch
             xla_stats.note_train_step(self._jit_fwd_bwd, batches=1)
         for name, val in aux_up.items():

@@ -438,12 +438,13 @@ class HybridBlock(Block):
             # (docs/architecture/note_memory.md); usage:
             # net.hybridize(remat=True)
             traced = jax.checkpoint(traced, static_argnums=(2,))
-        from .. import xla_stats
-        # compile-accounted: hybridize retraces (shape/dtype churn at the
-        # block's inputs) surface as jit_retraces_total{site=} with an
-        # explained signature diff; lineage = this block, so rebuilt
-        # jits of ONE net diff while unrelated nets never cross-diff
-        self._cached_jit = xla_stats.tracked_jit(
+        from .. import compiled as compiled_mod
+        # one CompiledProgram per hybridized block: retraces (shape/dtype
+        # churn at the block's inputs) surface as jit_retraces_total{site=}
+        # with an explained signature diff; lineage = this block, so
+        # rebuilt jits of ONE net diff while unrelated nets never
+        # cross-diff
+        self._cached_jit = compiled_mod.tracked_jit(
             traced, "gluon.hybrid_forward", static_argnums=(2,),
             lineage=id(self))
 

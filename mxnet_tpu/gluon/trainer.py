@@ -15,10 +15,14 @@ from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
 
+#: per-process trainer index for distinct memory-ledger scopes
+import itertools
+_TRAINER_IDS = itertools.count()
+
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None, spmd=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -42,6 +46,61 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._fused = None  # lazily resolved FusedApplier (or False)
         self._stepper = stepprof.ImplicitStepper()
+        # spmd: a parallel.spmd policy (name / ShardingPolicy / option
+        # dict) — parameters are re-placed with the policy's
+        # NamedSharding specs so the hybridized forward/backward runs
+        # SPMD over the named mesh with the gradient sync in-program
+        self._spmd = None
+        # per-instance ledger scope: two trainers in one process (GAN
+        # generator+discriminator) must not overwrite each other's
+        # shard-bytes entry
+        idx = next(_TRAINER_IDS)
+        self._ledger_scope = "gluon_trainer" if idx == 0 \
+            else "gluon_trainer_%d" % idx
+        if spmd is not None:
+            from ..parallel import spmd as spmd_mod
+            self._spmd = spmd_mod.resolve(spmd)
+            self.place_params()
+
+    def place_params(self):
+        """Re-place every initialized Parameter (data AND grad buffers)
+        per the trainer's SPMD policy, and record the per-device shard
+        bytes in the memory ledger. Called from ``__init__`` and again
+        at kvstore init (the first ``step()``/``allreduce_grads()``/
+        ``update()``) so deferred-init params are covered on every
+        entry path."""
+        if self._spmd is None:
+            return
+        import jax
+        from .. import xla_stats
+        placed = []
+        for param in self._params:
+            if param._data is None:
+                continue
+            sh = self._spmd.param_sharding(param.name, param._data.shape)
+            param._data._data = jax.device_put(param._data._data, sh)
+            if param._grad is not None:
+                param._grad._data = jax.device_put(param._grad._data, sh)
+            placed.append(param._data)
+        if placed:
+            xla_stats.ledger_set(self._ledger_scope, "params",
+                                 xla_stats.tree_shard_bytes(placed))
+
+    def place_batch(self, *arrays):
+        """Place input NDArrays batch-sharded along the policy mesh's
+        'data' axis (the `gluon.utils.split_and_load` analog for SPMD
+        training: params are placed by the policy, inputs by this).
+        Returns the placed NDArrays (one, or a tuple)."""
+        if self._spmd is None:
+            return arrays[0] if len(arrays) == 1 else arrays
+        import jax
+        sh = self._spmd.batch_sharding()
+        out = []
+        for a in arrays:
+            self._spmd.check_batch("input", a.shape)
+            a._data = jax.device_put(a._data, sh)
+            out.append(a)
+        return out[0] if len(out) == 1 else tuple(out)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -87,6 +146,10 @@ class Trainer:
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True
+        # deferred-init params materialized by the first forward get
+        # their policy placement here, whichever entry path (step /
+        # allreduce_grads / update) initialized the kvstore
+        self.place_params()
 
     @property
     def learning_rate(self):

@@ -84,6 +84,10 @@ class GradientCompression:
         self._jit_quantize = None
         self._jit_dequantize = None
         self._jit_dequantize_sum = None
+        #: packed-code bytes of the most recent quantize_keyed call —
+        #: what the wire would carry; the kvstore folds this into
+        #: kvstore_compressed_bytes_total
+        self.last_packed_nbytes = 0
 
     def get_params(self):
         return {"type": self.type, "threshold": str(self.threshold)}
@@ -129,6 +133,7 @@ class GradientCompression:
             res = jnp.zeros(grad_data.shape, grad_data.dtype)
         packed, new_res = self.quantize(grad_data, res)
         self._residuals[key] = new_res
+        self.last_packed_nbytes = int(packed.nbytes)
         return packed
 
     # -- kvstore integration --------------------------------------------

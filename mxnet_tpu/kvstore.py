@@ -71,6 +71,15 @@ def _record_kv(op, t0, values, store_type):
     return nbytes
 
 
+def _count_compressed_bytes(nbytes):
+    """Fold one compression's packed-code byte count into
+    ``kvstore_compressed_bytes_total`` (what the wire carries)."""
+    telemetry.counter(
+        "kvstore_compressed_bytes_total",
+        help="packed 2-bit code bytes produced by gradient compression "
+             "(what the wire carries)").inc(nbytes)
+
+
 def _ctx_group_sum(vals):
     """Sum a list of NDArrays (possibly on different devices) onto vals[0]'s
     device with a pairwise tree (reference CommDevice's tree/P2P reduce,
@@ -172,6 +181,7 @@ class KVStore:
                 # (and error feedback) still apply, like the reference's
                 # device-comm compression
                 merged = self._gc.compress(k, merged)
+                _count_compressed_bytes(self._gc.last_packed_nbytes)
             merged_list.append(merged)
         if self.num_workers > 1:
             if self._gc is not None:
@@ -309,6 +319,7 @@ class KVStore:
             self._last_wire_bytes = sum(int(p.nbytes) for p in packed)
             self._last_dense_bytes = sum(
                 int(merged_list[i]._data.nbytes) for i in dense_ix)
+            _count_compressed_bytes(self._last_wire_bytes)
             gathered = dist.allgather_arrays(packed)
             for i, g in zip(dense_ix, gathered):
                 m = merged_list[i]

@@ -172,7 +172,7 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, batches_per_dispatch=1,
-            scan_unroll=None, elastic=None):
+            scan_unroll=None, elastic=None, spmd=None):
         """Reference base_module.py:395 training loop.
 
         TPU extension: ``batches_per_dispatch=K`` groups K batches into ONE
@@ -180,6 +180,13 @@ class BaseModule:
         device and a lax.scan carries params/optimizer state through the K
         fused train steps). Metrics and batch callbacks still fire per
         batch, from the scan's stacked per-step outputs.
+
+        SPMD extension: ``spmd=`` selects a `parallel.spmd` sharding
+        policy (``"data_parallel"`` / ``"fsdp"`` / ``"tensor"``, a
+        ``ShardingPolicy``, or an option dict) for the bind — parameters
+        and optimizer state get real ``NamedSharding`` specs over the
+        named mesh and the gradient sync runs inside the compiled step
+        (see ``docs/architecture/sharding.md``).
 
         Elastic extension: ``elastic=`` (a checkpoint directory path, or a
         dict ``{"path": ..., "period": epochs, "keep_last": N}``) makes the
@@ -192,9 +199,15 @@ class BaseModule:
         if initializer is None:
             initializer = Uniform(0.01)
 
+        bind_kwargs = {}
+        if spmd is not None:
+            # only Module-family binds accept spmd; passing it
+            # unconditionally would break python_module subclasses
+            bind_kwargs["spmd"] = spmd
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
+                  for_training=True, force_rebind=force_rebind,
+                  **bind_kwargs)
         if monitor is not None:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer, arg_params=arg_params,

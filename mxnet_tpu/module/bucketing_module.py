@@ -134,7 +134,7 @@ class BucketingModule(BaseModule):
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
+             grad_req="write", spmd=None):
         assert shared_module is None, \
             "shared_module for BucketingModule is not supported"
         if force_rebind:
@@ -146,6 +146,7 @@ class BucketingModule(BaseModule):
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
         self._grad_req = grad_req
+        self._spmd_arg = spmd  # bucket executors share the policy mesh
 
         symbol, data_names, label_names = self._call_sym_gen(self._default_bucket_key)
         module = Module(symbol, data_names, label_names, logger=self.logger,
@@ -155,7 +156,8 @@ class BucketingModule(BaseModule):
                         group2ctxs=self._group2ctxs,
                         compression_params=self._compression_params)
         module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
-                    force_rebind=False, shared_module=None, grad_req=self._grad_req)
+                    force_rebind=False, shared_module=None,
+                    grad_req=self._grad_req, spmd=spmd)
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
         self._buckets[self._default_bucket_key] = module
@@ -174,7 +176,8 @@ class BucketingModule(BaseModule):
             module.bind(data_shapes, label_shapes, self._curr_module.for_training,
                         self._curr_module.inputs_need_grad, force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key],
-                        grad_req=self._grad_req)
+                        grad_req=self._grad_req,
+                        spmd=getattr(self, "_spmd_arg", None))
             if self._monitor is not None:
                 module.install_monitor(self._monitor)
             if self.params_initialized:

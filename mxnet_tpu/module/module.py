@@ -165,6 +165,9 @@ class Module(BaseModule):
         self._monitor = None
         self._fused_plan = None
         self._scan_plans = None
+        self._spmd = None  # ShardingPolicy once bound over a mesh
+        self._spmd_explicit = False  # spmd=.../MXNET_SPMD opt-in (donation)
+        self._spmd_infer = None  # out-shapes cache from the placement map
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -294,12 +297,21 @@ class Module(BaseModule):
     # -- bind ------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write", type_dict=None):
+             grad_req="write", type_dict=None, spmd=None):
         """``type_dict`` (TPU extension): per-argument dtype overrides, e.g.
         ``{'data': 'bfloat16', **{p: 'bfloat16' for p in param_names}}`` for
         MXU-native bf16 training; aux states (BN moving stats) keep f32
         unless named explicitly. The reference reaches the same state via
-        per-var __dtype__ attrs + infer_type."""
+        per-var __dtype__ attrs + infer_type.
+
+        ``spmd`` (TPU extension): a `parallel.spmd` sharding policy —
+        ``"data_parallel"`` / ``"fsdp"`` / ``"tensor"``, a
+        ``ShardingPolicy``, or an option dict — selecting how parameters
+        and the batch are laid out over the named mesh. With a
+        multi-device ``context`` list the mesh spans those devices;
+        with a single (default) context it spans every local device.
+        Multi-device contexts without ``spmd`` keep the historical
+        replicated data-parallel layout (overridable via ``MXNET_SPMD``)."""
         if force_rebind:
             self._exec = None
             self.binded = False
@@ -332,7 +344,7 @@ class Module(BaseModule):
         self._fused_plan = None
         self._scan_plans = None
         ctx = self._context[0]
-        shardings = self._dp_shardings(shapes)
+        shardings = self._spmd_shardings(shapes, spmd, type_dict)
         # group2ctxs: reference accepts a dict or a per-dp-replica list of
         # dicts (executor_group.py); the SPMD dp path replaces per-replica
         # executors, so one group map applies
@@ -350,19 +362,25 @@ class Module(BaseModule):
                                           shardings=shardings,
                                           group2ctx=g2c,
                                           type_dict=type_dict, **shapes)
-        # memory ledger: what this module pinned in device memory
+        # memory ledger: what this module pinned in device memory —
+        # PER-DEVICE shard bytes (== global bytes when replicated or
+        # single-device), so memory_report() and serving admission
+        # control see the HBM a device actually holds under FSDP
         from .. import xla_stats
         scope = self._ledger_scope()
-        xla_stats.ledger_set(scope, "params", xla_stats.tree_bytes(
+        xla_stats.ledger_set(scope, "params", xla_stats.tree_shard_bytes(
             [self._exec.arg_dict[n] for n in self._param_names
              if n in self._exec.arg_dict]))
-        xla_stats.ledger_set(scope, "grads", xla_stats.tree_bytes(
+        xla_stats.ledger_set(scope, "grads", xla_stats.tree_shard_bytes(
             [g for g in self._exec.grad_dict.values() if g is not None]))
-        xla_stats.ledger_set(scope, "aux", xla_stats.tree_bytes(
+        xla_stats.ledger_set(scope, "aux", xla_stats.tree_shard_bytes(
             list(self._exec.aux_dict.values())))
         self._opt_bytes_noted = False
-        from ..symbol.symbol import _graph_infer
-        _, self._out_shapes, _ = _graph_infer(self._symbol, shapes)
+        if getattr(self, "_spmd_infer", None) is not None:
+            self._out_shapes = self._spmd_infer  # inferred with the map
+        else:
+            from ..symbol.symbol import _graph_infer
+            _, self._out_shapes, _ = _graph_infer(self._symbol, shapes)
         self.binded = True
         # restore previously held params (e.g. after Module.load)
         if self._arg_params is not None:
@@ -391,46 +409,81 @@ class Module(BaseModule):
         return name or type(self).__name__.lower()
 
     def _note_optimizer_bytes(self, state_arrays):
-        """One-time optimizer-state byte accounting (first update)."""
+        """One-time optimizer-state byte accounting (first update):
+        per-device shard bytes — under FSDP the optimizer state inherits
+        the parameter sharding, and the ledger must record what one
+        device holds, not the global figure."""
         if getattr(self, "_opt_bytes_noted", False):
             return
         from .. import xla_stats
         xla_stats.ledger_set(self._ledger_scope(), "optimizer",
-                             xla_stats.tree_bytes(state_arrays))
+                             xla_stats.tree_shard_bytes(state_arrays))
         self._opt_bytes_noted = True
 
-    def _dp_shardings(self, shapes):
-        """SPMD data parallelism over a multi-device context list: ONE
-        executor whose buffers live on a 'dp' mesh — inputs batch-sharded,
-        params/aux replicated; XLA inserts the gradient all-reduce. The
-        reference instead runs one executor per device and reduces grads
-        through the KVStore (executor_group.py:129,289,330); the in-program
-        psum subsumes that reduction.
+    def _spmd_shardings(self, shapes, spmd, type_dict=None):
+        """Placement map for SPMD training: ONE executor whose buffers
+        live on a named mesh — inputs sharded along 'data', parameters
+        laid out by the selected `parallel.spmd.ShardingPolicy`
+        (replicated / fsdp-sharded / tensor-sharded); gradients and
+        optimizer state inherit the parameter placement, so XLA issues
+        the gradient all-reduce (or reduce-scatter) INSIDE the compiled
+        step. The reference instead runs one executor per device and
+        reduces grads through the KVStore
+        (executor_group.py:129,289,330); the in-program collective
+        subsumes that reduction and overlaps it with backward.
 
-        Returns None for a single-device context (plain executor)."""
-        if len(self._context) <= 1:
+        Policy selection: the ``spmd`` bind argument; else ``MXNET_SPMD``
+        for multi-device contexts; else plain replicated data parallelism
+        for multi-device contexts; else None (single-device executor)."""
+        from ..parallel import spmd as spmd_mod
+        # explicit selection (the spmd= argument or MXNET_SPMD) unlocks
+        # the policy extras — notably param-buffer donation; the implicit
+        # multi-device default keeps the legacy data-parallel guarantees
+        # (params NOT donated: user code may hold views)
+        explicit = spmd is not None
+        if spmd is None:
+            try:
+                spmd = spmd_mod.default_policy_name() \
+                    if len(self._context) > 1 else None
+            except ValueError as e:  # bad MXNET_SPMD value
+                raise MXNetError(str(e))
+            explicit = spmd is not None
+            if spmd is None and len(self._context) > 1:
+                spmd = "data_parallel"
+        if spmd is None:
+            self._spmd = None
+            self._spmd_explicit = False
+            self._spmd_infer = None
             return None
-        from ..parallel.mesh import batch_sharding, replicated_sharding
-        devices = [c.jax_device() for c in self._context]
-        ndev = len(devices)
+        self._spmd_explicit = explicit
+        if len(self._context) > 1:
+            devices = [c.jax_device() for c in self._context]
+        else:
+            import jax
+            devices = list(jax.devices())  # spmd over all local devices
+        try:
+            policy = spmd_mod.resolve(spmd, devices=devices)
+        except (TypeError, ValueError) as e:  # bad policy / devices
+            raise MXNetError(str(e))
+        self._spmd = policy
+        from ..symbol.symbol import _graph_infer
+        arg_shapes_d, out_shapes, _ = _graph_infer(
+            self._symbol, shapes, type_dict=type_dict)
+        self._spmd_infer = out_shapes  # reused by bind: one inference
         input_names = set(self._data_names) | set(self._label_names) \
             | set(self._state_names)
-        for name, shape in shapes.items():
-            if not shape or shape[0] % ndev != 0:
-                raise MXNetError(
-                    "input %s batch dim %s is not divisible by the %d "
-                    "devices of the dp mesh" % (name, shape, ndev))
-        try:
-            batched = batch_sharding(devices)  # shared cached dp mesh
-        except ValueError as e:  # duplicate devices in the context list
-            raise MXNetError(str(e))
-        repl = replicated_sharding(devices)
-        shardings = {}
+        arg_shapes = {}
         for name in self._symbol.list_arguments():
-            shardings[name] = batched if name in input_names else repl
-        for name in self._aux_names:
-            shardings[name] = repl
-        return shardings
+            shape = shapes.get(name, arg_shapes_d.get(name))
+            if shape is None:
+                raise MXNetError("cannot infer shape of argument %s for "
+                                 "spmd placement" % name)
+            arg_shapes[name] = tuple(shape)
+        try:
+            return policy.shardings_for(arg_shapes, input_names,
+                                        aux_names=self._aux_names)
+        except ValueError as e:  # indivisible batch dim
+            raise MXNetError(str(e))
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
@@ -723,14 +776,28 @@ class Module(BaseModule):
             return outs, aux_up, new_ws, new_states, out_grads
 
         # donate the optimizer states (rebound after the call); params are
-        # not donated — user code may hold views of the old weight buffers.
-        # CPU backends don't implement donation (JAX warns per compile).
-        donate = (7,) if getattr(self._context[0], "device_type", "cpu") \
-            not in ("cpu", "cpu_pinned", "cpu_shared") else ()
-        from .. import xla_stats
-        step_fn = xla_stats.tracked_jit(step, "module.fused_step",
-                                        donate_argnums=donate,
-                                        lineage=id(self))
+        # not donated by default — user code may hold views of the old
+        # weight buffers. Under an EXPLICITLY selected SPMD policy
+        # (spmd=.../MXNET_SPMD — not the implicit multi-device default,
+        # which keeps the legacy buffer-lifetime guarantee) the step ALSO
+        # donates the param buffers (grad_args, arg 0): old params are
+        # rebound from the program outputs every step, and freeing them
+        # halves transient param memory — the donate_argnums ask of
+        # ROADMAP item 1 (MXNET_SPMD_DONATE=0 opts out).
+        from .. import compiled as compiled_mod
+        # inputs_need_grad puts the data/label buffers in grad_args too;
+        # they are NOT rebound from program outputs after the step, so
+        # donating arg 0 would leave them deleted — params-only donation
+        # requires every grad_args leaf to be a rebound parameter
+        spmd_donate = getattr(self, "_spmd_explicit", False) \
+            and not self.inputs_need_grad \
+            and compiled_mod.spmd_donate_enabled()
+        donate = (0, 7) if spmd_donate else (7,)
+        donate = compiled_mod.donate_argnums_for(self._context[0], donate)
+        step_fn = compiled_mod.tracked_jit(step, "module.fused_step",
+                                           donate_argnums=donate,
+                                           lineage=id(self),
+                                           policy=self._spmd)
         indices = [self._param_names.index(n) for n in live_names]
         return (live_names, indices, fused, step_fn, step)
 
@@ -868,18 +935,23 @@ class Module(BaseModule):
             # old weight buffers, and fit() mixes scan and plain steps in
             # one epoch when the batch count isn't a multiple of K, so the
             # two paths must give the same buffer-lifetime guarantee).
-            # Module.scan_donate_params=True additionally donates the
-            # params carry — an opt-in for benchmark/throughput loops that
-            # hold no views of the old weight buffers. CPU lacks donation.
-            on_accel = getattr(self._context[0], "device_type", "cpu") \
-                not in ("cpu", "cpu_pinned", "cpu_shared")
-            donate = (8,) if on_accel else ()
-            if on_accel and getattr(self, "scan_donate_params", False):
+            # Module.scan_donate_params=True (or an EXPLICIT spmd policy,
+            # whose plain-step path donates params too) additionally
+            # donates the params carry. compiled.donate_argnums_for
+            # strips the set on CPU backends, which lack donation.
+            from .. import compiled as compiled_mod
+            spmd_donate = getattr(self, "_spmd_explicit", False) \
+                and compiled_mod.spmd_donate_enabled()
+            donate = (8,)
+            if getattr(self, "scan_donate_params", False) or spmd_donate:
                 donate = (0, 8)
-            from .. import xla_stats
-            scan_fn = xla_stats.tracked_jit(scan_step, "module.scan_step",
-                                            donate_argnums=donate,
-                                            lineage=id(self))
+            donate = compiled_mod.donate_argnums_for(self._context[0],
+                                                     donate)
+            scan_fn = compiled_mod.tracked_jit(scan_step,
+                                               "module.scan_step",
+                                               donate_argnums=donate,
+                                               lineage=id(self),
+                                               policy=self._spmd)
             if self._scan_plans is None:
                 self._scan_plans = {}
             self._scan_plans[plan_key] = scan_fn

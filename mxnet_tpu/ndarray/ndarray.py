@@ -206,8 +206,16 @@ class NDArray:
                     new = jax.device_put(new, dev)
                 self._data = new
         else:
-            self._data = self._data.at[key].set(value.astype(self.dtype)
-                                                if hasattr(value, "astype") else value)
+            dev = device_of(self._data)
+            new = self._data.at[key].set(value.astype(self.dtype)
+                                         if hasattr(value, "astype") else value)
+            # scatter results may come back with a different placement
+            # than self (the compiler can pick replicated for a small
+            # mesh-sharded operand): an in-place write must never move
+            # this array off its committed device/sharding
+            if dev is not None and device_of(new) != dev:
+                new = jax.device_put(new, dev)
+            self._data = new
 
     def slice_assign(self, rhs, begin, end, step=None):
         key = tuple(slice(b, e, s) for b, e, s in

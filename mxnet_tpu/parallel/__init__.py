@@ -5,8 +5,11 @@ multi-node story (SURVEY.md §2.8): DataParallelExecutorGroup, KVStore comm
 trees, NCCL, and the ps-lite parameter server all collapse into sharding
 annotations over a `jax.sharding.Mesh` with XLA-inserted collectives.
 """
-from .mesh import MeshContext, get_mesh, data_parallel_mesh, make_mesh
+from .mesh import (MeshContext, get_mesh, data_parallel_mesh, make_mesh,
+                   named_mesh)
 from . import dist
+from . import spmd
+from .spmd import ShardingPolicy, make_policy, spmd_mesh
 from .data_parallel import (DataParallelTrainStep, ShardedTrainStep,
                             split_and_load_sharded, sgd_update)
 from .ring_attention import (ring_attention, ulysses_attention,
@@ -22,6 +25,8 @@ from .elastic import (ElasticCheckpointer, ElasticTrainer, run_elastic,
 
 __all__ = ["pipeline_apply", "stack_stage_params", "moe_apply", "stack_expert_params",
            "MeshContext", "get_mesh", "data_parallel_mesh", "make_mesh",
+           "named_mesh", "spmd", "ShardingPolicy", "make_policy",
+           "spmd_mesh",
            "dist", "DataParallelTrainStep", "ShardedTrainStep",
            "PipelineTrainStep", "MoETrainStep", "sgd_update",
            "split_and_load_sharded",

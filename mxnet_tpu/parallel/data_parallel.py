@@ -32,8 +32,11 @@ def sgd_update(lr):
     return update
 
 
-def _jit_step(loss_fn, optimizer_update, donate_params):
-    """Shared fwd+bwd+update jit for every *TrainStep front door.
+def _jit_step(loss_fn, optimizer_update, donate_params, policy=None):
+    """Shared fwd+bwd+update CompiledProgram for every *TrainStep front
+    door. ``policy`` (anything with a ``mesh``) makes trace/compile/
+    dispatch run under the named mesh so in-function sharding
+    constraints resolve.
 
     With ``donate_params=True`` the params/opt_state buffers passed to the
     step are DONATED (in-place update): the caller's references are invalid
@@ -44,9 +47,10 @@ def _jit_step(loss_fn, optimizer_update, donate_params):
         new_params, new_opt_state = optimizer_update(params, grads, opt_state)
         return loss, new_params, new_opt_state
 
-    from ..xla_stats import tracked_jit
+    from ..compiled import tracked_jit
     return tracked_jit(step, "data_parallel.step",
-                       donate_argnums=(0, 1) if donate_params else ())
+                       donate_argnums=(0, 1) if donate_params else (),
+                       policy=policy)
 
 
 def shard_leading_axis(mesh, axis, tree):
@@ -85,7 +89,8 @@ class DataParallelTrainStep:
         # input shardings come from place_params/place_batch device_put;
         # GSPMD propagates them through the step. donate_params invalidates
         # the params/opt_state passed in (see _jit_step).
-        self._step = _jit_step(loss_fn, optimizer_update, donate_params)
+        self._step = _jit_step(loss_fn, optimizer_update, donate_params,
+                               policy=self)
         self._stepper = _stepprof.ImplicitStepper()
 
     def place_params(self, params):
@@ -137,7 +142,8 @@ class ShardedTrainStep:
         self.mesh = mesh
         self._param_spec = param_spec
         self._batch_axis = batch_axis
-        self._step = _jit_step(loss_fn, optimizer_update, donate_params)
+        self._step = _jit_step(loss_fn, optimizer_update, donate_params,
+                               policy=self)
         self._stepper = _stepprof.ImplicitStepper()
 
     def _spec_tree(self, params):

@@ -149,19 +149,20 @@ def _clear_jax_distributed_state():
     try:
         from jax._src import distributed as _jd
         state = _jd.global_state
-    except Exception:  # pragma: no cover - internal layout moved
+    except Exception as exc:  # pragma: no cover - internal layout moved
+        telemetry.swallowed("dist.clear_state", exc)
         return
     for attr in ("client", "service", "preemption_sync_manager"):
         obj = getattr(state, attr, None)
         if obj is not None:
             try:
                 obj.shutdown()
-            except Exception:
-                pass
+            except Exception as exc:  # half-dead client: clearing wins
+                telemetry.swallowed("dist.clear_state.shutdown", exc)
             try:
                 setattr(state, attr, None)
-            except Exception:  # pragma: no cover
-                pass
+            except Exception as exc:  # pragma: no cover
+                telemetry.swallowed("dist.clear_state.setattr", exc)
 
 
 def shutdown():
@@ -175,14 +176,15 @@ def shutdown():
     stop_heartbeat()
     try:
         jax.distributed.shutdown()
-    except Exception:  # not initialized / coordinator already gone
-        pass
+    except Exception as exc:  # not initialized / coordinator already gone
+        telemetry.swallowed("dist.shutdown", exc)
     _clear_jax_distributed_state()  # a half-failed shutdown must not
     _initialized = False            # block the next initialize
     _PMESH = None
     _AR_JIT.clear()
     from . import mesh as _mesh
     _mesh._DP_MESHES.clear()
+    _mesh._NAMED_MESHES.clear()
 
 
 def rank():
@@ -346,7 +348,8 @@ def _coordinator_client():
     try:
         from jax._src import distributed
         return distributed.global_state.client
-    except Exception:  # pragma: no cover
+    except Exception as exc:  # pragma: no cover
+        telemetry.swallowed("dist.coordinator_client", exc)
         return None
 
 
@@ -386,7 +389,8 @@ def start_heartbeat(interval=5.0):
             try:
                 client.key_value_set("%s/%d" % (_HB_PREFIX, me),
                                      repr(now), allow_overwrite=True)
-            except Exception:  # pragma: no cover - coordinator gone
+            except Exception as exc:  # pragma: no cover - coord. gone
+                telemetry.swallowed("dist.heartbeat_write", exc)
                 return
             if stop_evt.wait(interval):
                 return
@@ -447,7 +451,8 @@ def _count_stale_peers(timeout):
     import time as _time
     try:
         entries = client.key_value_dir_get(_HB_PREFIX)
-    except Exception:
+    except Exception as exc:  # no beats written yet / coordinator gone
+        telemetry.swallowed("dist.heartbeat_read", exc)
         return 0
     if not entries:
         return 0

@@ -70,6 +70,7 @@ def _flight_dump(reason, error=None):
     try:
         from .. import xla_stats
         xla_stats.dump_flight_recorder(reason, error=error)
+    # mxanalyze: allow(swallowed-exception): os._exit path — the post-mortem dump must never block (or crash) the exit
     except Exception:   # pragma: no cover - never block the exit path
         pass
 
@@ -372,8 +373,10 @@ class ElasticTrainer:
                     # race the step loop for armed chaos triggers
                     dead = dist._num_dead_nodes_nochaos(
                         self.dead_node_timeout)
-                except Exception:
-                    continue  # coordinator hiccup: the step loop retries
+                except Exception as exc:
+                    # coordinator hiccup: the step loop retries
+                    telemetry.swallowed("elastic.watchdog_poll", exc)
+                    continue
                 if dead:
                     logging.error(
                         "elastic watchdog: %d dead node(s); exiting %d "
@@ -638,6 +641,8 @@ def supervise(worker_argv, nprocs, max_restarts=3, env=None, log_dir=None,
             # a launch-time failure (fork pressure, log-file open error)
             # is a failed round to back off and retry, not a reason to
             # abandon the pod with restarts remaining
+            logging.warning("elastic supervisor round %d launch/poll "
+                            "failed: %s", restart, exc)
             failed = "round %d launch/poll failed: %s" % (restart, exc)
         finally:
             for p in procs:
@@ -646,8 +651,8 @@ def supervise(worker_argv, nprocs, max_restarts=3, env=None, log_dir=None,
             for p in procs:
                 try:
                     p.wait(timeout=30)
-                except Exception:
-                    pass
+                except Exception as exc:  # already-reaped / wedged child
+                    telemetry.swallowed("elastic.supervise_reap", exc)
             for _, fh in logs:
                 fh.close()
         if failed is None:

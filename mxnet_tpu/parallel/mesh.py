@@ -17,7 +17,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
-__all__ = ["MeshContext", "get_mesh", "make_mesh", "data_parallel_mesh",
+__all__ = ["MeshContext", "get_mesh", "make_mesh", "named_mesh",
+           "data_parallel_mesh",
            "replicated_sharding", "batch_sharding", "PartitionSpec",
            "NamedSharding"]
 
@@ -26,6 +27,34 @@ _STATE = threading.local()
 # dp meshes built from device tuples, cached so every Parameter/batch over
 # the same device list shares ONE Mesh object (jit caches key on sharding)
 _DP_MESHES = {}
+
+# named meshes keyed on (devices, axis layout) — the SPMD policy layer
+# (parallel/spmd.py) builds its ('data',) / ('data', 'model') meshes
+# through here so every policy over the same devices shares ONE Mesh
+_NAMED_MESHES = {}
+
+
+def named_mesh(devices, axis_shapes):
+    """Cached Mesh over an EXPLICIT device list with named axes
+    (``{'data': 4, 'model': 2}``; sizes must multiply to the device
+    count). Unlike :func:`make_mesh` this never silently drops trailing
+    devices, and repeated calls with the same layout return the same
+    Mesh object (jit caches key on sharding identity-equal meshes)."""
+    devices = tuple(devices)
+    key = (devices, tuple(axis_shapes.items()))
+    mesh = _NAMED_MESHES.get(key)
+    if mesh is None:
+        if len(set(devices)) != len(devices):
+            raise ValueError("duplicate devices in %s" % (list(devices),))
+        names = tuple(axis_shapes.keys())
+        sizes = tuple(int(s) for s in axis_shapes.values())
+        total = int(np.prod(sizes)) if sizes else 1
+        if total != len(devices):
+            raise ValueError("mesh axes %s need %d devices, got %d"
+                             % (dict(axis_shapes), total, len(devices)))
+        mesh = Mesh(np.asarray(list(devices)).reshape(sizes), names)
+        _NAMED_MESHES[key] = mesh
+    return mesh
 
 
 def _dp_mesh_for(devices):

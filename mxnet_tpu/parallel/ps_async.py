@@ -271,6 +271,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             try:
                 rhdr, rpayload = self.server.ps.handle(hdr, payload)
+            # mxanalyze: allow(swallowed-exception): not swallowed — the error is serialized into an err frame and re-raised worker-side by AsyncPSClient
             except Exception as e:  # surface server-side errors to worker
                 rhdr, rpayload = {"op": "err", "msg": repr(e)}, None
             _send_frame(self.request, rhdr,

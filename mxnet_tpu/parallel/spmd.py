@@ -1,0 +1,247 @@
+"""SPMD sharding policies over a named mesh.
+
+The pjit-everywhere layer (ROADMAP item 1): training runs as ONE
+compiled program spanning a `jax.sharding.Mesh`, with parameter and
+batch placement decided by a :class:`ShardingPolicy` instead of
+per-call-site device lists. Three policies ship:
+
+- ``data_parallel`` — params/optimizer state replicated, batch sharded
+  along the mesh ``data`` axis. XLA inserts the gradient all-reduce
+  INSIDE the compiled step (the mean loss over the sharded batch
+  induces the psum), overlapping it with backward — the post-step
+  kvstore device sync of ``kvstore='tpu'`` disappears.
+- ``fsdp`` — ZeRO-3 style: every parameter (and its gradient and
+  optimizer state, which inherit the placement) is SHARDED along
+  ``data`` on its largest divisible dimension; XLA all-gathers each
+  weight where the forward needs it and reduce-scatters its gradient.
+  Per-device param+optimizer bytes drop to ~1/N — the policy that fits
+  models whose replicated state exceeds one device's HBM.
+- ``tensor`` — Megatron-style for the FC/RNN blocks: the mesh gains a
+  ``model`` axis; 2-D+ weights shard their output-unit dimension (dim 0
+  in the MXNet ``(units, in_units)`` layout) and matching biases shard
+  with them, activations travel via XLA-inserted collectives over
+  ``model`` while the batch still shards over ``data``.
+
+Selection: ``Module.fit(spmd=...)`` / ``Module.bind(spmd=...)`` and
+``gluon.Trainer(spmd=...)`` accept a policy name, a
+:class:`ShardingPolicy`, or a ``{"policy": ..., **options}`` dict;
+``MXNET_SPMD`` supplies a process-wide default for multi-device Modules
+that did not ask explicitly. Grounded in SNIPPETS.md [1]-[3]
+(NamedSharding helpers, ``pjit(in/out_shardings, donate_argnums)``).
+
+Env knobs (documented in docs/env_var.md): ``MXNET_SPMD``,
+``MXNET_SPMD_MODEL_AXIS``, ``MXNET_SPMD_DONATE`` (read by
+`mxnet_tpu.compiled.donate_argnums_for`).
+"""
+from __future__ import annotations
+
+import os
+
+from .. import telemetry
+
+__all__ = ["ShardingPolicy", "make_policy", "resolve", "spmd_mesh",
+           "POLICIES", "default_policy_name"]
+
+#: the parameter-sharding policies Module.fit(spmd=...) accepts
+POLICIES = ("data_parallel", "fsdp", "tensor")
+
+
+def default_policy_name():
+    """Process-wide default policy for multi-device Modules that did not
+    pass ``spmd=`` explicitly: ``MXNET_SPMD`` when set (a policy name,
+    or empty/``0`` to force plain data_parallel), else ``None`` meaning
+    "keep the historical multi-device default" (data_parallel)."""
+    name = os.environ.get("MXNET_SPMD", "").strip()
+    if not name or name == "0":
+        return None
+    if name not in POLICIES:
+        raise ValueError("MXNET_SPMD=%r is not one of %s"
+                         % (name, list(POLICIES)))
+    return name
+
+
+def _model_axis_size(n_devices, requested=None):
+    """Size of the 'model' mesh axis for the tensor policy: the
+    requested value (arg or MXNET_SPMD_MODEL_AXIS, default 2) clamped to
+    a divisor of the device count."""
+    if requested is None:
+        requested = int(os.environ.get("MXNET_SPMD_MODEL_AXIS", "2"))
+    requested = max(1, int(requested))
+    while n_devices % requested:
+        requested -= 1
+    return requested
+
+
+def spmd_mesh(devices=None, model_axis=None, with_model_axis=False):
+    """Named mesh for the SPMD policies: axes ``('data',)`` — or
+    ``('data', 'model')`` when a model axis is requested — over
+    ``devices`` (default: every local device). Extends
+    `parallel/mesh.py`'s flat dp meshes with the named-axis layout the
+    policies partition against."""
+    import jax
+    from .mesh import named_mesh
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = len(devices)
+    if not with_model_axis:
+        return named_mesh(devices, {"data": n})
+    model = _model_axis_size(n, model_axis)
+    return named_mesh(devices, {"data": n // model, "model": model})
+
+
+class ShardingPolicy:
+    """Placement rules for one named mesh: parameter specs, batch specs,
+    and the bookkeeping the memory ledger and tests introspect.
+
+    ``param_spec(name, shape)`` returns the `PartitionSpec` for a
+    parameter; ``batch_sharding()`` / ``param_sharding(...)`` /
+    ``replicated()`` return committed `NamedSharding`\\ s. Gradients and
+    optimizer state never get their own specs: they inherit the
+    parameter placement through the compiled program (GSPMD propagates
+    shardings from the committed inputs), which is what makes the
+    gradient reduction an IN-PROGRAM collective rather than a post-step
+    kvstore sync.
+    """
+
+    def __init__(self, name, mesh):
+        if name not in POLICIES:
+            raise ValueError("unknown SPMD policy %r (one of %s)"
+                             % (name, list(POLICIES)))
+        self.name = name
+        self.mesh = mesh
+        if "data" not in mesh.axis_names:
+            raise ValueError("SPMD mesh %s has no 'data' axis"
+                             % (mesh.axis_names,))
+        if name == "tensor" and "model" not in mesh.axis_names:
+            raise ValueError("tensor policy needs a 'model' mesh axis, "
+                             "got %s" % (mesh.axis_names,))
+        self.data_size = int(mesh.shape["data"])
+        self.model_size = int(mesh.shape.get("model", 1))
+
+    # -- specs -----------------------------------------------------------
+    def batch_spec(self):
+        """Leading (batch) axis sharded along 'data', rest replicated."""
+        from jax.sharding import PartitionSpec as P
+        return P("data")
+
+    def param_spec(self, name, shape):
+        """PartitionSpec for parameter ``name`` of ``shape``:
+
+        - data_parallel: replicated;
+        - fsdp: largest dimension divisible by the 'data' axis size is
+          sharded on 'data' (ties break to the earliest dim); params
+          with no divisible dim stay replicated;
+        - tensor: dim 0 (output units in the MXNet ``(units, in_units)``
+          weight layout) sharded on 'model' when divisible — weights AND
+          their biases, so a Dense's sharded output units keep bias
+          columns local; remaining dims replicated. Params the model
+          axis does not divide fall back to the fsdp rule on 'data'
+          so large embeddings still shard.
+        """
+        from jax.sharding import PartitionSpec as P
+        shape = tuple(int(s) for s in shape)
+        if self.name == "data_parallel" or not shape:
+            return P()
+        if self.name == "tensor":
+            if shape[0] % self.model_size == 0 and self.model_size > 1:
+                return P("model")
+            return self._fsdp_spec(shape)
+        return self._fsdp_spec(shape)
+
+    def _fsdp_spec(self, shape):
+        from jax.sharding import PartitionSpec as P
+        best = None
+        for i, s in enumerate(shape):
+            if s % self.data_size == 0 and (best is None
+                                            or s > shape[best]):
+                best = i
+        if best is None or self.data_size <= 1:
+            return P()
+        # trailing Nones are trimmed: jax normalizes them away in program
+        # OUTPUT shardings, and a bind-time P('data', None) diffing
+        # against a step-output P('data') would read as a (spurious)
+        # retrace at the second step
+        return P(*([None] * best + ["data"]))
+
+    # -- committed shardings --------------------------------------------
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def param_sharding(self, name, shape):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, self.param_spec(name, shape))
+
+    def check_batch(self, name, shape):
+        """Raise with a precise message when an input's batch dim cannot
+        shard over the 'data' axis."""
+        if not shape or int(shape[0]) % self.data_size != 0:
+            raise ValueError(
+                "input %s batch dim %s is not divisible by the %d-way "
+                "'data' axis of the %s mesh"
+                % (name, tuple(shape), self.data_size, self.name))
+
+    def shardings_for(self, arg_shapes, input_names, aux_names=()):
+        """name -> NamedSharding over every argument and aux state of a
+        bound program: inputs batch-sharded, params per policy, aux
+        (BN moving stats) replicated — the Module.bind placement map."""
+        out = {}
+        input_names = set(input_names)
+        for name, shape in arg_shapes.items():
+            if name in input_names:
+                self.check_batch(name, shape)
+                out[name] = self.batch_sharding()
+            else:
+                out[name] = self.param_sharding(name, shape)
+        for name in aux_names:
+            out[name] = self.replicated()
+        return out
+
+    def describe(self):
+        return {"policy": self.name,
+                "axes": {a: int(self.mesh.shape[a])
+                         for a in self.mesh.axis_names},
+                "devices": int(self.mesh.devices.size)}
+
+    def __repr__(self):
+        return "ShardingPolicy(%s, mesh=%s)" % (self.name,
+                                                dict(self.mesh.shape))
+
+
+def make_policy(name, devices=None, model_axis=None):
+    """Build a :class:`ShardingPolicy` by name over ``devices`` (default
+    all local devices). ``model_axis`` sizes the tensor policy's
+    'model' dimension (default ``MXNET_SPMD_MODEL_AXIS``, 2)."""
+    mesh = spmd_mesh(devices, model_axis=model_axis,
+                     with_model_axis=(name == "tensor"))
+    policy = ShardingPolicy(name, mesh)
+    telemetry.counter("spmd_policies_total",
+                      help="ShardingPolicy constructions by policy",
+                      policy=name).inc()
+    return policy
+
+
+def resolve(spmd, devices=None):
+    """Normalize a user-facing ``spmd=`` argument — a policy name, a
+    :class:`ShardingPolicy` (returned as-is; ``devices`` is then
+    ignored), or an option dict ``{"policy": name, "model_axis": k}``."""
+    if isinstance(spmd, ShardingPolicy):
+        return spmd
+    if isinstance(spmd, str):
+        return make_policy(spmd, devices=devices)
+    if isinstance(spmd, dict):
+        opts = dict(spmd)
+        name = opts.pop("policy", None)
+        if name is None:
+            raise ValueError("spmd dict needs a 'policy' key (one of %s)"
+                             % (list(POLICIES),))
+        unknown = set(opts) - {"model_axis", "devices"}
+        if unknown:
+            raise ValueError("unknown spmd option(s) %s" % sorted(unknown))
+        return make_policy(name, devices=opts.get("devices", devices),
+                           model_axis=opts.get("model_axis"))
+    raise TypeError("spmd must be a policy name %s, a ShardingPolicy, or "
+                    "an option dict; got %r" % (list(POLICIES), spmd))

@@ -34,14 +34,15 @@ class _RandState(threading.local):
         self.override = None
 
     def key_for(self, dev):
+        dev = _normalize_dev(dev)
         key = self.keys.get(dev)
         if key is None:
             key = jax.random.PRNGKey(self.dev_seeds.get(dev, self.seed_val))
             if dev is not None:
                 if hasattr(dev, "device_set"):
-                    # a Sharding (SPMD executor): one REPLICATED chain whose
-                    # stream matches the lead device's single-device chain,
-                    # so an N-device run reproduces the 1-device trajectory
+                    # SPMD executor: the replicated chain's stream matches
+                    # the lead device's single-device chain, so an
+                    # N-device run reproduces the 1-device trajectory
                     lead = min(dev.device_set, key=lambda d: d.id)
                     key = jax.random.fold_in(key, lead.id)
                     key = jax.device_put(key, dev)
@@ -56,6 +57,22 @@ class _RandState(threading.local):
 
 
 _STATE = _RandState()
+
+
+def _normalize_dev(dev):
+    """Key-chain identity for a placement: a Sharding is normalized to
+    the REPLICATED sharding over its mesh — a (2,) key can never carry a
+    sharded spec (an fsdp/tensor param used as the placement anchor
+    would otherwise try to split the key across devices), and all
+    anchors over one mesh share a single chain. EVERY chain read/write
+    must go through this, or a sharded anchor would read one cache entry
+    and advance another (a frozen key chain)."""
+    if hasattr(dev, "device_set"):
+        mesh = getattr(dev, "mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            return NamedSharding(mesh, PartitionSpec())
+    return dev
 
 
 def _resolve_device(ctx):
@@ -98,6 +115,7 @@ def seed(seed_state, ctx="all"):
 
 def _split_chain(dev):
     """Advance dev's key chain, returning a fresh subkey."""
+    dev = _normalize_dev(dev)  # same identity key_for cached under
     key = _STATE.key_for(dev)
     _STATE.keys[dev], sub = jax.random.split(key)
     return sub

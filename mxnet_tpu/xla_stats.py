@@ -7,11 +7,12 @@ On a JAX/XLA stack the expensive silent failure modes live BELOW the
 host, and this module is the layer that surfaces them into the same
 registry:
 
-1. **Compile accounting** — :func:`tracked_jit` wraps every jit entry
-   point in the framework (executor forward / fused fwd+bwd, Module's
-   fused and scanned train steps, gluon hybridize, the data-parallel
-   front doors) with a shared tracker that owns a signature ->
-   executable cache:
+1. **Compile accounting** — every jit entry point in the framework
+   (executor forward / fused fwd+bwd, Module's fused and scanned train
+   steps, gluon hybridize, the data-parallel front doors) is a
+   `mxnet_tpu.compiled.CompiledProgram`, which owns the signature ->
+   executable cache / AOT warmup / donation machinery and reports back
+   into this module's registry series:
 
    - ``jit_compiles_total{site=}`` / ``jit_cache_hits_total{site=}`` /
      ``jit_retraces_total{site=}`` counters (plus unlabeled totals);
@@ -25,15 +26,18 @@ registry:
      ``retrace executor.forward: arg0['data']: shape (4, 10) ->
      (8, 10) (dim 0: 4 -> 8)`` instead of a jit cache dump.
 
-   The tracker compiles ahead-of-time (``fn.lower(*args).compile()``)
+   The program compiles ahead-of-time (``fn.lower(*args).compile()``)
    and calls the executable directly — one compile per signature, and
-   the compiled object is the source for :func:`~TrackedJit.last_flops`
+   the compiled object is the source for ``last_flops``
    (``cost_analysis``) and the activation-byte ledger
    (``memory_analysis``). Tracer inputs (a tracked function called
    inside an outer trace, e.g. gluon's vjp path) fall through to the
    plain jit dispatch. ``MXNET_XLA_STATS=0`` disables tracking
    entirely; ``MXNET_XLA_STATS_AOT=0`` keeps the accounting but calls
    through the normal jit path (no cost analysis).
+
+   ``tracked_jit`` / ``TrackedJit`` remain importable here as aliases
+   of the one implementation in `mxnet_tpu/compiled.py`.
 
 2. **Memory ledger** — :func:`ledger_set` byte accounting per
    (scope, section): Module.bind records params/grads/aux, the first
@@ -64,9 +68,10 @@ registry:
    state survives kills that skip ``atexit``.
 
 Lock order (checked by ``tools/mxanalyze`` lock-discipline): a
-``TrackedJit``'s per-instance ``_compile_lock`` may be held when the
-module-global ``_lock`` is taken (compile bookkeeping); never the
-reverse. Telemetry's registry lock is innermost of all.
+``CompiledProgram``'s per-instance ``_compile_lock`` may be held when
+`compiled`'s module-global ``_lock`` or this module's ``_lock`` is
+taken (compile bookkeeping); never the reverse. Telemetry's registry
+lock is innermost of all.
 
 Import cost: stdlib + telemetry only — jax is imported lazily inside
 functions, so the chaos/elastic exit paths can reach the recorder even
@@ -86,7 +91,8 @@ from . import telemetry
 __all__ = ["TrackedJit", "tracked_jit", "aot_compile", "compile_counts",
            "last_retrace",
            "explain_signature_change", "ledger_set", "ledger",
-           "tree_bytes", "device_memory", "live_buffers", "memory_report",
+           "tree_bytes", "tree_shard_bytes", "device_memory",
+           "live_buffers", "memory_report",
            "peak_flops_per_device", "peak_flops_total", "note_train_step",
            "flops_per_batch", "goodput", "publish_goodput", "mfu_of",
            "FlightRecorder", "flight_recorder", "reset"]
@@ -94,162 +100,66 @@ __all__ = ["TrackedJit", "tracked_jit", "aot_compile", "compile_counts",
 logger = logging.getLogger("mxnet_tpu.xla_stats")
 
 _lock = threading.RLock()
-_sites = {}    # (site, lineage) -> {"compiles": int, "sig": dict or None}
 _ledger = {}   # (scope, section) -> bytes
 _step = {"flops_per_batch": 0.0, "site": None, "batches": 0,
          "updated": 0.0}
-_state = {"last_retrace": None}
-
-
-def _enabled():
-    return os.environ.get("MXNET_XLA_STATS", "1") != "0"
-
-
-def _aot_enabled():
-    return os.environ.get("MXNET_XLA_STATS_AOT", "1") != "0"
 
 
 def reset():
     """Drop per-site compile state, the ledger, goodput state, and the
     flight-recorder ring (tests). Registry metrics are NOT touched —
     pair with ``telemetry.reset()``."""
+    from . import compiled
+    compiled.reset()
     with _lock:
-        _sites.clear()
         _ledger.clear()
         _step.update(flops_per_batch=0.0, site=None, batches=0,
                      updated=0.0)
-        _state["last_retrace"] = None
     flight_recorder.clear()
 
 
 # ---------------------------------------------------------------------------
-# Abstract signatures: fast hashable keys + printable descriptions
+# Compile machinery: one implementation, in mxnet_tpu/compiled.py.
+# These names stay importable here for back-compat and for tests that
+# treat xla_stats as the observability facade.
 # ---------------------------------------------------------------------------
 
-def _describe_leaf(x):
-    """Hashable description of one argument leaf. Array-likes are
-    abstracted to (shape, dtype, weak_type, sharding) — values never
-    enter, so hyperparameters that change per step cannot fake a
-    retrace. Python scalars are type-only (jit traces them)."""
-    if x is None:
-        return ("none",)
-    shape = getattr(x, "shape", None)
-    dtype = getattr(x, "dtype", None)
-    if shape is not None and dtype is not None:
-        weak = bool(getattr(getattr(x, "aval", None), "weak_type", False))
-        sharding = getattr(x, "sharding", None)
-        return ("array", tuple(shape), str(dtype), weak, sharding)
-    if isinstance(x, (bool, int, float, complex, str, bytes)):
-        return ("scalar", type(x).__name__)
-    return ("opaque", type(x).__name__)
+def tracked_jit(fun, site, static_argnums=(), lineage=None, **jit_kwargs):
+    """Alias of :func:`mxnet_tpu.compiled.tracked_jit` (the one
+    compiled-program factory)."""
+    from . import compiled
+    # mxanalyze: allow(retrace-hazard): pass-through alias — static_argnums is forwarded verbatim, linted at the caller's wrap site
+    return compiled.tracked_jit(fun, site, static_argnums=static_argnums,
+                                lineage=lineage, **jit_kwargs)
 
 
-def _key_leaf(x):
-    """Per-call fast variant of :func:`_describe_leaf`: same abstraction
-    but keeps dtype/sharding as hashable OBJECTS (str(dtype) alone costs
-    ~6us a leaf, which dominates dispatch at ResNet parameter counts)."""
-    if x is None:
-        return ("none",)
-    shape = getattr(x, "shape", None)
-    dtype = getattr(x, "dtype", None)
-    if shape is not None and dtype is not None:
-        aval = getattr(x, "aval", None)
-        weak = aval.weak_type if aval is not None else False
-        return ("array", tuple(shape), dtype, weak,
-                getattr(x, "sharding", None))
-    if isinstance(x, (bool, int, float, complex, str, bytes)):
-        return ("scalar", type(x).__name__)
-    return ("opaque", type(x).__name__)
-
-
-def _key_of(obj):
-    if isinstance(obj, dict):
-        try:
-            items = sorted(obj.items())
-        except TypeError:   # mixed/unorderable keys
-            items = sorted(obj.items(), key=lambda kv: str(kv[0]))
-        return ("d",) + tuple((k, _key_of(v)) for k, v in items)
-    if isinstance(obj, (list, tuple)):
-        return ("t",) + tuple(_key_of(v) for v in obj)
-    return _key_leaf(obj)
-
-
-def _describe_args(args, static):
-    """{path: leaf description} over the positional args — built only on
-    cache miss, for the retrace explainer."""
-    entries = {}
-
-    def walk(prefix, obj):
-        if isinstance(obj, dict):
-            for k in sorted(obj, key=str):
-                walk("%s[%r]" % (prefix, k), obj[k])
-        elif isinstance(obj, (list, tuple)):
-            for i, v in enumerate(obj):
-                walk("%s[%d]" % (prefix, i), v)
-        else:
-            entries[prefix] = _describe_leaf(obj)
-
-    for i, a in enumerate(args):
-        if i in static:
-            entries["arg%d(static)" % i] = ("static", repr(a))
-        else:
-            walk("arg%d" % i, a)
-    return entries
-
-
-def _fmt_desc(d):
-    if d[0] == "array":
-        out = "shape %s dtype %s" % (tuple(d[1]), d[2])
-        if d[3]:
-            out += " (weak)"
-        return out
-    if d[0] == "static":
-        return "static %s" % d[1]
-    if d[0] == "scalar":
-        return "python %s" % d[1]
-    return d[0]
-
-
-def _diff_desc(a, b):
-    if a[0] == "array" and b[0] == "array":
-        parts = []
-        if a[1] != b[1]:
-            msg = "shape %s -> %s" % (tuple(a[1]), tuple(b[1]))
-            if len(a[1]) == len(b[1]):
-                dims = ", ".join("dim %d: %s -> %s" % (i, x, y)
-                                 for i, (x, y) in enumerate(zip(a[1], b[1]))
-                                 if x != y)
-                msg += " (%s)" % dims
-            parts.append(msg)
-        if a[2] != b[2]:
-            parts.append("dtype %s -> %s" % (a[2], b[2]))
-        if a[3] != b[3]:
-            parts.append("weak_type %s -> %s" % (a[3], b[3]))
-        if a[4] != b[4]:
-            parts.append("sharding %s -> %s" % (a[4], b[4]))
-        return ", ".join(parts) or "changed"
-    if a[0] == "static" and b[0] == "static":
-        return "static value %s -> %s" % (a[1], b[1])
-    return "%s -> %s" % (_fmt_desc(a), _fmt_desc(b))
+def aot_compile(jitted, *args):
+    """Alias of :func:`mxnet_tpu.compiled.aot_compile`."""
+    from . import compiled
+    return compiled.aot_compile(jitted, *args)
 
 
 def explain_signature_change(old, new):
-    """Human-readable diff of two ``_describe_args`` signatures: names
-    every path whose abstract description changed, down to the dimension
-    for rank-preserving shape changes."""
-    parts = []
-    for k in sorted(set(old) | set(new)):
-        a, b = old.get(k), new.get(k)
-        if a == b:
-            continue
-        if a is None:
-            parts.append("%s: new input (%s)" % (k, _fmt_desc(b)))
-        elif b is None:
-            parts.append("%s: input removed (was %s)" % (k, _fmt_desc(a)))
-        else:
-            parts.append("%s: %s" % (k, _diff_desc(a, b)))
-    return "; ".join(parts) or \
-        "no signature change detected (new code object or closure)"
+    """Alias of :func:`mxnet_tpu.compiled.explain_signature_change`."""
+    from . import compiled
+    return compiled.explain_signature_change(old, new)
+
+
+def last_retrace():
+    """Metadata of the most recent retrace: ``{"site", "reason",
+    "compiles", "time"}`` or None."""
+    from . import compiled
+    return compiled.last_retrace()
+
+
+def __getattr__(name):
+    # TrackedJit is the historical name of compiled.CompiledProgram;
+    # resolved lazily to keep this module importable with no jax.
+    if name == "TrackedJit":
+        from . import compiled
+        return compiled.CompiledProgram
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
 
 
 def compile_counts():
@@ -265,225 +175,6 @@ def compile_counts():
         m = telemetry.get_metric(name)
         out[key] = float(m.value) if m is not None else 0.0
     return out
-
-
-def last_retrace():
-    """Metadata of the most recent retrace: ``{"site", "reason",
-    "compiles", "time"}`` or None."""
-    with _lock:
-        return dict(_state["last_retrace"]) if _state["last_retrace"] \
-            else None
-
-
-# ---------------------------------------------------------------------------
-# Compile tracking
-# ---------------------------------------------------------------------------
-
-def _count(name, site, help=""):
-    telemetry.counter(name, help=help).inc()
-    telemetry.counter(name, help=help, site=site).inc()
-
-
-def _flops_of(compiled):
-    try:
-        cost = compiled.cost_analysis()
-    except Exception as exc:
-        telemetry.swallowed("xla_stats.cost_analysis", exc)
-        return None
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0] if cost else {}
-    try:
-        f = cost.get("flops")
-    except AttributeError:
-        return None
-    # XLA reports negative flops (-1/-2) for computations it cannot
-    # cost (callbacks, custom calls): that is "unknown", not a figure
-    return float(f) if f is not None and f > 0 else None
-
-
-def _memory_of(compiled):
-    try:
-        m = compiled.memory_analysis()
-        return {"argument_bytes": int(m.argument_size_in_bytes),
-                "output_bytes": int(m.output_size_in_bytes),
-                "temp_bytes": int(m.temp_size_in_bytes),
-                "code_bytes": int(m.generated_code_size_in_bytes)}
-    except Exception as exc:
-        telemetry.swallowed("xla_stats.memory_analysis", exc)
-        return None
-
-
-class _Entry:
-    __slots__ = ("compiled", "flops", "memory")
-
-    def __init__(self, compiled, flops, memory):
-        self.compiled = compiled
-        self.flops = flops
-        self.memory = memory
-
-
-class TrackedJit:
-    """A ``jax.jit`` with compile accounting (see module docstring).
-
-    Owns a signature -> compiled-executable cache. A miss is a compile
-    (and, beyond the lineage's first, a retrace with an explained
-    diff); a hit calls the cached executable. Tracer inputs and keyword
-    calls fall through to the plain jit dispatch path.
-
-    ``lineage`` scopes retrace detection: wrappers sharing (site,
-    lineage) — e.g. the executors a Module rebinds over one Symbol, or
-    the rebuilt jits of one gluon block — diff against each other, so a
-    reshape-triggered recompile IS reported as a retrace; wrappers with
-    different lineages (two unrelated models hitting the same site in
-    one process) never cross-diff, and the second model's first compile
-    is just a compile. Default: this wrapper instance only.
-    """
-
-    def __init__(self, fun, site, static_argnums=(), lineage=None,
-                 **jit_kwargs):
-        import jax
-        if isinstance(static_argnums, int):
-            static_argnums = (static_argnums,)
-        self.site = site
-        self._lineage = (site, lineage if lineage is not None
-                         else id(self))
-        self._static = frozenset(static_argnums)
-        # mxanalyze: allow(retrace-hazard): pass-through wrapper — the static set is the caller's literal, linted at the caller's wrap site
-        self._fn = jax.jit(fun, static_argnums=tuple(static_argnums),
-                           **jit_kwargs)
-        self._cache = {}
-        self._compile_lock = threading.Lock()
-        self.last_flops = None
-        self.last_memory = None
-
-    # jax.jit API passthroughs used by callers/tests
-    def lower(self, *args, **kwargs):
-        return self._fn.lower(*args, **kwargs)
-
-    def __call__(self, *args, **kwargs):
-        import jax
-        if kwargs or not jax.core.trace_state_clean():
-            # called inside an outer trace (vjp/scan over a tracked fn)
-            # or with kwargs: the plain dispatch path handles both
-            return self._fn(*args, **kwargs)
-        key = tuple(("s", a) if i in self._static and _hashable(a)
-                    else _key_of(a) for i, a in enumerate(args))
-        entry = self._cache.get(key)
-        if entry is None:
-            entry = self._compile_entry(key, args)
-        else:
-            _count("jit_cache_hits_total", self.site,
-                   help="tracked jit calls served by a cached executable")
-        self.last_flops = entry.flops
-        self.last_memory = entry.memory
-        if entry.compiled is None:
-            return self._fn(*args)
-        call_args = [a for i, a in enumerate(args) if i not in self._static]
-        try:
-            return entry.compiled(*call_args)
-        except (TypeError, ValueError) as exc:
-            # argument validation the signature key did not capture
-            # (e.g. an uncommitted array moved device): disable AOT for
-            # this signature and let jit's own cache take over
-            logger.warning("xla_stats[%s]: compiled call rejected (%s); "
-                           "falling back to jit dispatch", self.site, exc)
-            _count("jit_aot_fallbacks_total", self.site,
-                   help="tracked executables rejected at call time")
-            entry.compiled = None
-            return self._fn(*args)
-
-    def _compile_entry(self, key, args):
-        with self._compile_lock:
-            entry = self._cache.get(key)
-            if entry is not None:   # raced with another thread
-                _count("jit_cache_hits_total", self.site)
-                return entry
-            sig = _describe_args(args, self._static)
-            with _lock:
-                st = _sites.setdefault(self._lineage,
-                                       {"compiles": 0, "sig": None})
-                st["compiles"] += 1
-                n = st["compiles"]
-                prev = st["sig"]
-                st["sig"] = sig
-            reason = None
-            if prev is not None:
-                reason = explain_signature_change(prev, sig)
-                with _lock:
-                    _state["last_retrace"] = {
-                        "site": self.site, "reason": reason,
-                        "compiles": n, "time": time.time()}
-                _count("jit_retraces_total", self.site,
-                       help="compiles beyond the first at a jit site")
-                logger.warning("jit retrace [%s] (compile #%d): %s",
-                               self.site, n, reason)
-            _count("jit_compiles_total", self.site,
-                   help="XLA compiles at tracked jit sites")
-            t0 = time.perf_counter()
-            compiled = None
-            if _aot_enabled():
-                try:
-                    compiled = self._fn.lower(*args).compile()
-                except Exception as exc:
-                    # trace/compile errors must surface through the
-                    # plain call below, with jit's own diagnostics
-                    logger.debug("xla_stats[%s]: AOT compile failed "
-                                 "(%s); deferring to jit dispatch",
-                                 self.site, exc)
-            dur = time.perf_counter() - t0
-            flops = _flops_of(compiled) if compiled is not None else None
-            memory = _memory_of(compiled) if compiled is not None else None
-            telemetry.histogram("jit_compile_seconds",
-                                help="lower+compile wall time per tracked "
-                                     "jit site", site=self.site).observe(dur)
-            telemetry.event("xla.compile", site=self.site, seconds=dur,
-                            compile_no=n, flops=flops,
-                            retrace=reason)
-            meta = {"site": self.site, "seconds": dur, "compile_no": n,
-                    "flops": flops, "memory": memory, "time": time.time(),
-                    "retrace": reason}
-            flight_recorder.last["compile"] = meta
-            if memory is not None:
-                ledger_set(self.site, "xla_temp", memory["temp_bytes"])
-                ledger_set(self.site, "xla_output", memory["output_bytes"])
-            entry = _Entry(compiled, flops, memory)
-            self._cache[key] = entry
-            return entry
-
-
-def _hashable(x):
-    try:
-        hash(x)
-        return True
-    except TypeError:
-        return False
-
-
-def tracked_jit(fun, site, static_argnums=(), lineage=None, **jit_kwargs):
-    """``jax.jit`` with compile accounting under ``site`` (retrace
-    detection scoped by ``lineage`` — see :class:`TrackedJit`); plain
-    ``jax.jit`` when tracking is disabled (``MXNET_XLA_STATS=0``)."""
-    if not _enabled():
-        import jax
-        # mxanalyze: allow(retrace-hazard): pass-through wrapper — static_argnums is forwarded verbatim
-        return jax.jit(fun, static_argnums=static_argnums, **jit_kwargs)
-    # mxanalyze: allow(retrace-hazard): pass-through wrapper — static_argnums is forwarded verbatim
-    return TrackedJit(fun, site, static_argnums=static_argnums,
-                      lineage=lineage, **jit_kwargs)
-
-
-def aot_compile(jitted, *args):
-    """Best-effort AOT compile of an (already jitted) callable for
-    ``args``. Returns ``(compiled, info)`` where ``info`` carries
-    ``flops``/``memory``; ``(None, None)`` when lowering fails (caller
-    keeps using the jitted function)."""
-    try:
-        compiled = jitted.lower(*args).compile()
-    except Exception as exc:
-        logger.debug("aot_compile failed: %s", exc)
-        return None, None
-    return compiled, {"flops": _flops_of(compiled),
-                      "memory": _memory_of(compiled)}
 
 
 # ---------------------------------------------------------------------------
@@ -517,6 +208,43 @@ def tree_bytes(tree):
         leaf = getattr(leaf, "_data", leaf)   # NDArray -> jax array
         total += int(getattr(leaf, "nbytes", 0) or 0)
     return total
+
+
+def _leaf_shard_bytes(leaf):
+    """PER-DEVICE bytes of one array leaf: the byte size of the shard a
+    single device holds under the leaf's sharding (== full nbytes for a
+    replicated or unsharded leaf)."""
+    leaf = getattr(leaf, "_data", leaf)   # NDArray -> jax array
+    nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+    sharding = getattr(leaf, "sharding", None)
+    shape = getattr(leaf, "shape", None)
+    if sharding is None or shape is None or not nbytes:
+        return nbytes
+    try:
+        shard_shape = sharding.shard_shape(tuple(shape))
+    except Exception as exc:   # non-XLA sharding object: global bytes
+        telemetry.swallowed("xla_stats.shard_bytes", exc)
+        return nbytes
+    total = 1
+    for s in shape:
+        total *= int(s)
+    per = 1
+    for s in shard_shape:
+        per *= int(s)
+    if total <= 0:
+        return nbytes
+    return int(nbytes * per // total)
+
+
+def tree_shard_bytes(tree):
+    """Per-DEVICE payload bytes of the array leaves of ``tree``: each
+    leaf contributes the bytes ONE device holds under its sharding, so
+    an FSDP-sharded parameter set reports global_bytes / shards — the
+    figure HBM admission control must budget against — while replicated
+    and single-device leaves report their full size (== `tree_bytes`)."""
+    import jax
+    return sum(_leaf_shard_bytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(tree))
 
 
 def device_memory(limit=64):

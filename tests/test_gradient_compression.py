@@ -154,3 +154,40 @@ def test_training_accuracy_with_compression():
     acc = dict(mod.score(mx.io.NDArrayIter(X, y.astype("f"), 50),
                          "acc"))["accuracy"]
     assert acc > 0.9, acc
+
+
+def test_tpu_kvstore_roundtrip_error_bound_and_bytes_counter():
+    """The `tpu` kvstore's compressed push path: (a) error feedback
+    bounds the round-trip error — with per-push gradients bounded by the
+    threshold, every element's residual (cumulative pushed minus
+    cumulative delivered) stays within ONE threshold — and (b)
+    `kvstore_compressed_bytes_total` counts the packed code bytes each
+    push produced."""
+    from mxnet_tpu import telemetry
+    kv = mx.kv.create("tpu")
+    thresh = 0.5
+    kv.set_gradient_compression({"type": "2bit", "threshold": thresh})
+    shape = (16, 8)
+    rng = np.random.RandomState(3)
+    kv.init(0, mx.nd.zeros(shape))
+    kv._set_updater(lambda key, grad, stored: None)  # keep store inert
+
+    c0 = telemetry.counter("kvstore_compressed_bytes_total").value
+    pushed_total = np.zeros(shape, np.float32)
+    pushes = 12
+    for _ in range(pushes):
+        # |g| <= threshold: the regime where the error-feedback residual
+        # provably stays within one threshold step per element
+        g = rng.uniform(-thresh, thresh, shape).astype(np.float32)
+        pushed_total += g
+        kv.push(0, mx.nd.array(g))
+    # delivered = pushed - residual; the residual is the ONLY loss, and
+    # error feedback keeps it within one threshold per element
+    residual = np.asarray(kv._gc._residuals[0])
+    np.testing.assert_array_less(np.abs(residual), thresh + 1e-6)
+    c1 = telemetry.counter("kvstore_compressed_bytes_total").value
+    packed_per_push = int(np.ceil(shape[0] * shape[1] / 4))  # 2-bit codes
+    assert c1 - c0 == pushes * packed_per_push
+    # the counted wire bytes are 16x smaller than the dense payload
+    dense_per_push = shape[0] * shape[1] * 4
+    assert (c1 - c0) * 16 == pushes * dense_per_push

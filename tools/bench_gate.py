@@ -47,6 +47,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN_METRIC = "resnet50_train_imgs_per_sec_bf16_bs128"
 INFER_METRIC = "resnet50_infer_imgs_per_sec_bs32"
 SERVE_METRIC = "serving_closed_p99_ms"
+MULTICHIP_METRIC = "multichip_scaling_efficiency"
 DEFAULT_THRESHOLD = 0.10
 
 
@@ -102,7 +103,11 @@ def load_history(history_dir=None, with_phases=False):
                                          lower_is_better(metric)):
                 phases[(metric, source)] = (float(value), ph)
 
-    paths = sorted(glob.glob(os.path.join(history_dir, "BENCH_*.json")))
+    # MULTICHIP_r*.json rounds carry the scaling-efficiency metric line
+    # in their "tail" the same way BENCH rounds carry the TRAIN one
+    paths = sorted(glob.glob(os.path.join(history_dir, "BENCH_*.json"))
+                   + glob.glob(os.path.join(history_dir,
+                                            "MULTICHIP_*.json")))
     for path in paths:
         name = os.path.basename(path)
         try:

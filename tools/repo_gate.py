@@ -66,6 +66,12 @@ def main(argv=None):
             # a serving run also gates its p99 tail (lower is better)
             rc = max(rc, bench_gate.gate_records(
                 records, metric=bench_gate.SERVE_METRIC, **kwargs))
+        if any(rec.get("metric") == bench_gate.MULTICHIP_METRIC
+               for rec in records):
+            # a multichip run also gates its scaling efficiency
+            # (higher is better, vs MULTICHIP_r*.json history)
+            rc = max(rc, bench_gate.gate_records(
+                records, metric=bench_gate.MULTICHIP_METRIC, **kwargs))
 
     return rc
 
