@@ -143,6 +143,29 @@ class Speedometer:
                  if delta.get(name, 0.0) / total >= 0.01]
         return "\t" + " | ".join(parts) if parts else ""
 
+    def _comm_suffix(self):
+        """"\\tcomm X% | overlap Y%" — predicted collective share of the
+        step wall and the estimated fraction of it hidden under compute
+        (`shardprof.comm_stats`). Gated by MXNET_STEPPROF like the phase
+        summary; "" when disabled or no compiled program carried
+        collectives (single-device training)."""
+        from . import stepprof
+        if not stepprof.enabled():
+            return ""
+        try:
+            from . import shardprof
+            comm = shardprof.comm_stats()
+        except Exception as exc:   # comm anatomy must never break a log
+            from . import telemetry
+            telemetry.swallowed("callback.comm_suffix", exc)
+            return ""
+        if not comm or comm.get("comm_fraction") is None:
+            return ""
+        out = "\tcomm %.0f%%" % (comm["comm_fraction"] * 100.0)
+        if comm.get("overlap_fraction") is not None:
+            out += " | overlap %.0f%%" % (comm["overlap_fraction"] * 100.0)
+        return out
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -153,7 +176,7 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = self._speed()
                 goodput = self._goodput_suffix()
-                phases = self._phase_suffix()
+                phases = self._phase_suffix() + self._comm_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

@@ -19,8 +19,10 @@ everything those five call sites used to reimplement independently:
   per-call-site device_type checks;
 - **cost-analysis / ledger hooks**: every compile records its FLOPs
   (``cost_analysis``) and temp/output bytes (``memory_analysis``) into
-  `xla_stats`' ledger, and the program keeps ``last_flops`` /
-  ``last_memory`` for the MFU pipeline (`xla_stats.note_train_step`);
+  `xla_stats`' ledger, its collective inventory (HLO-text parse) into
+  `shardprof`'s communication ledger, and the program keeps
+  ``last_flops`` / ``last_memory`` for the MFU pipeline
+  (`xla_stats.note_train_step`);
 - a **sharding policy** slot: a `parallel.spmd.ShardingPolicy` (or any
   object with a ``mesh``) attached at construction makes every
   compile/dispatch run under ``with policy.mesh``, so sharding
@@ -458,6 +460,15 @@ class CompiledProgram:
                     "retrace": reason}
             from . import xla_stats
             xla_stats.flight_recorder.last["compile"] = meta
+            if compiled is not None:
+                # communication anatomy: inventory the executable's
+                # collectives (HLO text parse — no compile of its own)
+                try:
+                    from . import shardprof
+                    shardprof.note_program(self.site, self._lineage,
+                                           compiled)
+                except Exception as exc:
+                    telemetry.swallowed("compiled.shardprof", exc)
             if memory is not None:
                 xla_stats.ledger_set(self.site, "xla_temp",
                                      memory["temp_bytes"])

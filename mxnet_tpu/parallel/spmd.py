@@ -40,7 +40,7 @@ import os
 from .. import telemetry
 
 __all__ = ["ShardingPolicy", "make_policy", "resolve", "spmd_mesh",
-           "POLICIES", "default_policy_name"]
+           "POLICIES", "default_policy_name", "spec_tuple"]
 
 #: the parameter-sharding policies Module.fit(spmd=...) accepts
 POLICIES = ("data_parallel", "fsdp", "tensor")
@@ -58,6 +58,17 @@ def default_policy_name():
         raise ValueError("MXNET_SPMD=%r is not one of %s"
                          % (name, list(POLICIES)))
     return name
+
+
+def spec_tuple(spec):
+    """Canonical tuple form of a PartitionSpec (or spec-like tuple):
+    trailing ``None`` entries trimmed, so a bind-time ``P('data', None)``
+    compares equal to the ``P('data')`` jax normalizes program outputs
+    to. The comparison key `shardprof.audit` diffs spec-vs-actual with."""
+    out = list(tuple(spec))
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
 
 
 def _model_axis_size(n_devices, requested=None):

@@ -492,20 +492,13 @@ class RequestTracer:
     def write_host_snapshot(self, dir=None, force=False):
         """Write this process's ``reqtrace_host<h>_pid<p>.json`` into
         ``dir`` (default: the configured telemetry dir; None and no dir
-        -> no-op). Atomic replace, like `telemetry.write_snapshot`."""
-        dir = dir or telemetry.configured_dir()
-        if dir is None:
-            return None
+        -> no-op) via `telemetry.write_host_json` — the one atomic
+        per-host snapshot transport (shared with stepprof and
+        shardprof)."""
         if not force and self._seq == 0:
             return None
-        os.makedirs(dir, exist_ok=True)
-        path = os.path.join(dir, "reqtrace_host%d_pid%d.json"
-                            % (telemetry.host_id(), os.getpid()))
-        tmp = "%s.tmp%d" % (path, threading.get_ident())
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(self.snapshot(), fh)
-        os.replace(tmp, path)
-        return path
+        return telemetry.write_host_json("reqtrace", self.snapshot(),
+                                         dir=dir)
 
 
 def _percentile(sorted_vals, q):
@@ -631,26 +624,9 @@ def classify(tail_shares, shed_fraction=0.0, pad_waste=None):
 
 def merge_host_snapshots(dir=None):
     """Read every ``reqtrace_host*.json`` under ``dir`` (default: the
-    configured telemetry dir), keeping the freshest snapshot per host.
-    Returns {host_id: snapshot_dict}."""
-    dir = dir or telemetry.configured_dir() \
-        or os.environ.get("MXNET_TELEMETRY_DIR")
-    if not dir or not os.path.isdir(dir):
-        return {}
-    hosts = {}
-    for fn in sorted(os.listdir(dir)):
-        if not (fn.startswith("reqtrace_host") and fn.endswith(".json")):
-            continue
-        try:
-            with open(os.path.join(dir, fn), "r", encoding="utf-8") as fh:
-                doc = json.load(fh)
-        except (OSError, ValueError):
-            continue  # torn/garbage snapshot from a killed writer
-        h = int(doc.get("host", 0))
-        if h not in hosts or doc.get("updated", 0) > \
-                hosts[h].get("updated", 0):
-            hosts[h] = doc
-    return hosts
+    configured telemetry dir), keeping the freshest snapshot per host
+    (`telemetry.merge_host_json`). Returns {host_id: snapshot_dict}."""
+    return telemetry.merge_host_json("reqtrace", dir)
 
 
 def _combine(hosts):

@@ -39,7 +39,10 @@ Three consumers sit on top:
    input-bound / dispatch-bound / sync-bound / compute-bound and picks
    the top remediation hint from ROADMAP item 2's attack list
    (donation missing, unfused optimizer, unbucketed shapes, prefetch
-   depth). CLI: ``python -m mxnet_tpu.stepprof report``.
+   depth). A `shardprof.comm_stats` dict adds the ``comm-bound`` class
+   for steps whose in-program collectives (invisible to the share
+   vector — they hide inside ``device_compute``) dominate the wall.
+   CLI: ``python -m mxnet_tpu.stepprof report``.
 
 Cross-host: when a telemetry dir is configured each process writes a
 small ``stepprof_host<h>_pid<p>.json`` snapshot (same per-host-file
@@ -125,6 +128,11 @@ HINTS = {
         "(scan_donate_params / donate_argnums — the memory ledger "
         "proves the copy elimination), then drive the mfu gauge toward "
         "target (ROADMAP item 2)",
+    "comm-bound":
+        "the interconnect dominates the step: predicted collective "
+        "time is a large share of the wall (shardprof report names the "
+        "kinds/bytes) — overlap the collectives with compute or shrink "
+        "the wire bytes (ROADMAP items 1-2)",
     "unknown":
         "no step-phase data recorded: run the training loop through "
         "Module.fit or wrap steps in stepprof.step()",
@@ -400,13 +408,25 @@ class StepProfiler:
 
     def snapshot(self):
         """One JSON-able view: identity, step stats, totals, shares,
-        overlap, verdict."""
+        overlap, verdict. The PROCESS profiler's verdict is
+        communication-aware (in-program collectives hide inside
+        ``device_compute``, so the share vector alone would misread a
+        comm-bound step as compute-bound); private test instances
+        classify their own shares only."""
         sh = self.shares()
-        v, hint = classify(sh)
+        comm = None
+        if self is profiler:
+            try:
+                from . import shardprof
+                comm = shardprof.comm_stats()
+            except Exception as exc:   # comm must never break a snapshot
+                telemetry.swallowed("stepprof.snapshot_comm", exc)
+        v, hint = classify(sh, comm=comm)
         doc = {"host": telemetry.host_id(), "pid": os.getpid(),
                "updated": time.time(),
                "phase_totals": self.totals(), "shares": sh,
-               "overlap": self.overlap(), "verdict": v, "hint": hint}
+               "overlap": self.overlap(), "comm": comm,
+               "verdict": v, "hint": hint}
         doc.update(self.step_stats())
         return doc
 
@@ -445,26 +465,13 @@ class StepProfiler:
     def write_host_snapshot(self, dir=None, force=False):
         """Write this process's ``stepprof_host<h>_pid<p>.json`` into
         ``dir`` (default: the configured telemetry dir; None and no dir
-        -> no-op, returns None). Atomic replace, like
-        `telemetry.write_snapshot`."""
-        dir = dir or telemetry.configured_dir()
-        if dir is None:
-            return None
+        -> no-op, returns None) via `telemetry.write_host_json` — the
+        one atomic per-host snapshot transport (shared with reqtrace
+        and shardprof)."""
         if not force and self._steps == 0:
             return None
-        os.makedirs(dir, exist_ok=True)
-        path = os.path.join(dir, "stepprof_host%d_pid%d.json"
-                            % (telemetry.host_id(), os.getpid()))
-        # tmp unique per writer THREAD: the 2 s export loop and a
-        # same-process force-write (atexit, bench attribution) may
-        # snapshot concurrently, and sharing one tmp would tear the
-        # freshly published file (same rationale as telemetry
-        # .write_snapshot)
-        tmp = "%s.tmp%d" % (path, threading.get_ident())
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(self.snapshot(), fh)
-        os.replace(tmp, path)
-        return path
+        return telemetry.write_host_json("stepprof", self.snapshot(),
+                                         dir=dir)
 
 
 profiler = StepProfiler()
@@ -656,7 +663,42 @@ def write_host_snapshot(dir=None, force=False):
 # Bottleneck verdict
 # ---------------------------------------------------------------------------
 
-def classify(shares, retraces=None, fused=None, donated=None):
+#: comm wins the verdict outright when predicted wire time is at least
+#: this share of the step wall (below it, comm still wins when it
+#: out-scores the dominant share group)
+COMM_BOUND_FRACTION = 0.4
+
+
+def _comm_hint(comm):
+    """ROADMAP-item-1/2-keyed remediation for a comm-bound step, picked
+    from the collective inventory shape (`shardprof.comm_stats`)."""
+    base = HINTS["comm-bound"]
+    ratio = comm.get("param_gather_ratio")
+    overlap = comm.get("overlap_fraction")
+    if overlap is not None and overlap >= 0.5:
+        # the wire is already mostly hidden yet still dominates: more
+        # overlap cannot win — shrink the bytes themselves
+        ratio = None
+    if ratio is not None and 0.5 <= ratio <= 2.0:
+        hint = ("all-gather/reduce-scatter bytes/step ~= param bytes: "
+                "the fsdp weight gather is not overlapped — enable "
+                "param donation (MXNET_SPMD_DONATE) and scan the steps "
+                "(fit(batches_per_dispatch=K)) so XLA prefetches the "
+                "next layer's gather during compute; then %s" % base)
+    elif comm.get("dominant_kind") == "all-reduce":
+        hint = ("all-reduce dominates (dp gradient sync): raise the "
+                "per-device batch, wire gradient_compression (2-bit), "
+                "or go fsdp so the sync becomes a reduce-scatter of "
+                "1/N bytes; then %s" % base)
+    else:
+        hint = base
+    if comm.get("overlap_fraction") is not None:
+        hint = ("only %.0f%% of predicted comm time is hidden under "
+                "compute; %s" % (comm["overlap_fraction"] * 100.0, hint))
+    return hint
+
+
+def classify(shares, retraces=None, fused=None, donated=None, comm=None):
     """(verdict, hint) from a phase-share dict.
 
     The verdict is the share-dominant group of :data:`VERDICT_GROUPS`
@@ -664,12 +706,27 @@ def classify(shares, retraces=None, fused=None, donated=None):
     group's ROADMAP-item-2 remediation, refined by the optional extras:
     ``retraces`` (dispatch-bound + retraces -> unbucketed shapes),
     ``fused=False`` (dispatch-bound -> unfused optimizer), and
-    ``donated=False`` (compute-bound -> donation missing)."""
+    ``donated=False`` (compute-bound -> donation missing).
+
+    ``comm`` — a `shardprof.comm_stats` dict — adds the ``comm-bound``
+    class: in-program collectives hide inside ``device_compute``, so a
+    share vector alone can never see them; when the predicted wire time
+    is a large share of the step wall (>= :data:`COMM_BOUND_FRACTION`,
+    or bigger than the dominant share group) the verdict becomes
+    ``comm-bound`` with a hint keyed to the inventory shape (fsdp
+    gather vs dp all-reduce, ROADMAP items 1-2)."""
     if not shares or sum(shares.values()) <= 0:
+        if comm and (comm.get("comm_fraction") or 0) \
+                >= COMM_BOUND_FRACTION:
+            return "comm-bound", _comm_hint(comm)
         return "unknown", HINTS["unknown"]
     scores = {v: sum(shares.get(p, 0.0) for p in group)
               for v, group in VERDICT_GROUPS.items()}
     verdict = max(VERDICT_GROUPS, key=lambda v: scores[v])
+    if comm:
+        cf = comm.get("comm_fraction") or 0.0
+        if cf >= COMM_BOUND_FRACTION or cf > scores[verdict]:
+            return "comm-bound", _comm_hint(comm)
     hint = HINTS[verdict]
     if verdict == "dispatch-bound":
         if retraces:
@@ -688,8 +745,16 @@ def classify(shares, retraces=None, fused=None, donated=None):
 
 
 def verdict(basis="p50"):
-    """(verdict, hint) of the live process profiler."""
-    return classify(profiler.shares(basis=basis))
+    """(verdict, hint) of the live process profiler, communication-
+    aware: the collective inventory of the live train step (when
+    `shardprof` recorded one) feeds the ``comm-bound`` class."""
+    comm = None
+    try:
+        from . import shardprof
+        comm = shardprof.comm_stats()
+    except Exception as exc:   # shardprof must never break a verdict
+        telemetry.swallowed("stepprof.comm_stats", exc)
+    return classify(profiler.shares(basis=basis), comm=comm)
 
 
 # ---------------------------------------------------------------------------
@@ -698,26 +763,9 @@ def verdict(basis="p50"):
 
 def merge_host_snapshots(dir=None):
     """Read every ``stepprof_host*.json`` under ``dir`` (default: the
-    configured telemetry dir), keeping the freshest snapshot per host.
-    Returns {host_id: snapshot_dict}."""
-    dir = dir or telemetry.configured_dir() \
-        or os.environ.get("MXNET_TELEMETRY_DIR")
-    if not dir or not os.path.isdir(dir):
-        return {}
-    hosts = {}
-    for fn in sorted(os.listdir(dir)):
-        if not (fn.startswith("stepprof_host") and fn.endswith(".json")):
-            continue
-        try:
-            with open(os.path.join(dir, fn), "r", encoding="utf-8") as fh:
-                doc = json.load(fh)
-        except (OSError, ValueError):
-            continue  # torn/garbage snapshot from a killed writer
-        h = int(doc.get("host", 0))
-        if h not in hosts or doc.get("updated", 0) > \
-                hosts[h].get("updated", 0):
-            hosts[h] = doc
-    return hosts
+    configured telemetry dir), keeping the freshest snapshot per host
+    (`telemetry.merge_host_json`). Returns {host_id: snapshot_dict}."""
+    return telemetry.merge_host_json("stepprof", dir)
 
 
 #: a host is named a straggler only when the skew is a real fraction of
@@ -808,20 +856,29 @@ def _load_source(path):
         if profiler.step_stats()["steps"] > 0:
             snap = profiler.snapshot()
             return {"shares": snap["shares"], "source": "live process",
-                    "straggler": None, "overlap": snap["overlap"]}
+                    "straggler": None, "overlap": snap["overlap"],
+                    "comm": snap.get("comm")}
         return {"shares": {}, "source": "none", "straggler": None,
                 "overlap": None}
     if os.path.isdir(path):
         merged = detect_stragglers(path)
         if merged["hosts"]:
             tot = {}
+            comm = None
             for d in merged["hosts"].values():
                 for k, v in (d.get("phase_totals") or {}).items():
                     tot[k] = tot.get(k, 0.0) + float(v)
+                # worst host's comm view: snapshots carry the per-host
+                # comm_stats dict since the communication-anatomy PR
+                c = d.get("comm")
+                if c and (comm is None
+                          or (c.get("comm_fraction") or 0)
+                          > (comm.get("comm_fraction") or 0)):
+                    comm = c
             return {"shares": _normalize(tot),
                     "source": "%d host snapshot(s) in %s"
                               % (len(merged["hosts"]), path),
-                    "straggler": merged, "overlap": None}
+                    "straggler": merged, "overlap": None, "comm": comm}
         tot = {}
         for fn in sorted(os.listdir(path)):
             if fn.endswith(".prom"):
@@ -841,7 +898,8 @@ def _load_source(path):
     sh = doc.get("shares") or doc.get("phases") or {}
     sh = {k: float(v) for k, v in sh.items() if isinstance(v, (int, float))}
     return {"shares": _normalize(sh), "source": path,
-            "straggler": None, "overlap": doc.get("overlap")}
+            "straggler": None, "overlap": doc.get("overlap"),
+            "comm": doc.get("comm")}
 
 
 def report(path=None, out=None, json_only=False):
@@ -851,7 +909,7 @@ def report(path=None, out=None, json_only=False):
     out = out or sys.stdout
     src = _load_source(path)
     sh = src["shares"]
-    v, hint = classify(sh)
+    v, hint = classify(sh, comm=src.get("comm"))
     if not json_only:
         out.write("Step-time anatomy (%s)\n" % src["source"])
         if sh:

@@ -56,7 +56,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "span", "event", "record_span", "configure", "configured_dir",
            "flush",
            "write_snapshot", "host_id", "set_host_id", "read_events",
-           "to_chrome", "merge", "add_tap", "remove_tap", "swallowed"]
+           "to_chrome", "merge", "add_tap", "remove_tap", "swallowed",
+           "write_host_json", "merge_host_json"]
 
 _logger = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -592,6 +593,56 @@ def write_snapshot(path=None):
         fh.write(dumps())
     os.replace(tmp, path)  # snapshot readers never see a torn write
     return path
+
+
+def write_host_json(prefix, doc, dir=None):
+    """THE per-host JSON snapshot transport: write ``doc`` as
+    ``<prefix>_host<h>_pid<p>.json`` under ``dir`` (default: the
+    configured telemetry dir; None and no dir -> no-op, returns None).
+    Atomic replace with a per-thread tmp name, like
+    :func:`write_snapshot`, so readers never see a torn file and two
+    same-process writers (a periodic exporter and an atexit flush)
+    cannot tear each other's publication. stepprof, serving/reqtrace,
+    and shardprof all ride this one implementation."""
+    dir = dir or configured_dir()
+    if dir is None:
+        return None
+    os.makedirs(dir, exist_ok=True)
+    path = os.path.join(dir, "%s_host%d_pid%d.json"
+                        % (prefix, host_id(), os.getpid()))
+    tmp = "%s.tmp%d" % (path, threading.get_ident())
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_host_json(prefix, dir=None):
+    """Read every ``<prefix>_host*.json`` under ``dir`` (default: the
+    configured telemetry dir, then ``MXNET_TELEMETRY_DIR``), keeping the
+    freshest snapshot per host by the docs' ``updated`` stamp. Torn or
+    garbage files from a killed writer are skipped, not fatal. Returns
+    ``{host_id: doc}``."""
+    dir = dir or configured_dir() or os.environ.get("MXNET_TELEMETRY_DIR")
+    if not dir or not os.path.isdir(dir):
+        return {}
+    hosts = {}
+    for fn in sorted(os.listdir(dir)):
+        if not (fn.startswith(prefix + "_host") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(dir, fn), "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        try:
+            h = int(doc.get("host", 0))
+        except (TypeError, ValueError):
+            continue
+        if h not in hosts or doc.get("updated", 0) > \
+                hosts[h].get("updated", 0):
+            hosts[h] = doc
+    return hosts
 
 
 def flush():

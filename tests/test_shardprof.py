@@ -1,0 +1,549 @@
+"""Communication anatomy (`mxnet_tpu/shardprof.py`): HLO collective
+extraction, the compile-hook ledger, the sharding audit, the overlap /
+comm-bound verdict, the report CLI, and the bench_gate comm delta.
+
+Runs on the forced 8-device CPU mesh from conftest. The acceptance
+assertions live here: a non-empty collective inventory for an FSDP
+`Module` train step (all-gather + a reduction collective, bytes > 0), a
+deliberately mis-replicated param flagged by the audit, a `comm-bound`
+verdict out of `stepprof.classify`, and `xla_stats.compile_counts()`
+proving the instrumentation itself adds zero compiles/retraces.
+"""
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import shardprof, stepprof, telemetry, xla_stats
+from mxnet_tpu.parallel import spmd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_gate  # noqa: E402
+
+
+@pytest.fixture
+def fresh():
+    """Clean registries: telemetry, stepprof, and the shardprof program
+    ledger (compile-accounting state untouched — tests diff it)."""
+    telemetry.reset()
+    stepprof.reset()
+    stepprof.disable()
+    shardprof.reset()
+    yield
+    shardprof.reset()
+    stepprof.disable()
+    stepprof.reset()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# HLO-text extractor fixtures (one line per collective kind + edge cases)
+# ---------------------------------------------------------------------------
+
+_HLO_FIXTURE = """\
+HloModule jit_step, entry_computation_layout={...}
+
+%fused_computation (param_0: f32[16,8]) -> f32[2,8] {
+  ROOT %slice = f32[2,8]{1,0} slice(f32[16,8]{1,0} %param_0)
+}
+
+ENTRY %main.42 {
+  %ar = f32[16,8]{1,0} all-reduce(f32[16,8]{1,0} %dot), channel_id=1, \
+replica_groups=[1,8]<=[8], use_global_device_ids=true, \
+metadata={op_name="jit(step)/all_reduce_thing"}
+  %ag = bf16[24,16]{1,0} all-gather(bf16[3,16]{1,0} %p0), channel_id=2, \
+replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+  %rs = f32[2,8]{1,0} reduce-scatter(f32[16,8]{1,0} %g), channel_id=3, \
+replica_groups=[1,8]<=[8], dimensions={0}
+  %cp = f32[4]{0} collective-permute(f32[4]{0} %x), channel_id=4, \
+source_target_pairs={{0,1},{1,0}}
+  %a2a = f32[8,4]{1,0} all-to-all(f32[8,4]{1,0} %y), channel_id=5, \
+replica_groups=[2,4]<=[8], dimensions={0}
+  %ags = (f32[3,16]{1,0}, f32[24,16]{1,0}) all-gather-start(\
+f32[3,16]{1,0} %p1), channel_id=6, replica_groups=[1,8]<=[8]
+  %agd = f32[24,16]{1,0} all-gather-done((f32[3,16]{1,0}, \
+f32[24,16]{1,0}) %ags)
+  %scalar = f32[] all-reduce(f32[] %loss), channel_id=7, \
+replica_groups=[1,8]<=[8], to_apply=%add
+  %renamed = f32[4]{0} add(f32[4]{0} %cp, f32[4]{0} %cp), \
+metadata={op_name="looks like all-gather in a name only"}
+}
+"""
+
+
+def test_parse_hlo_every_kind_counts_and_bytes():
+    colls = shardprof.parse_hlo_collectives(_HLO_FIXTURE)
+    by_kind = {}
+    for c in colls:
+        by_kind.setdefault(c["kind"], []).append(c)
+    # one of each kind, plus the async all-gather-start and the scalar
+    # all-reduce; the -done half and the metadata mention never count
+    assert len(by_kind["all-reduce"]) == 2
+    assert len(by_kind["all-gather"]) == 2
+    assert len(by_kind["reduce-scatter"]) == 1
+    assert len(by_kind["collective-permute"]) == 1
+    assert len(by_kind["all-to-all"]) == 1
+    # bytes: result-shape payload (bf16 = 2 bytes/elem)
+    assert by_kind["all-reduce"][0]["bytes"] == 16 * 8 * 4
+    assert by_kind["all-reduce"][1]["bytes"] == 4          # f32[] scalar
+    assert by_kind["all-gather"][0]["bytes"] == 24 * 16 * 2  # bf16
+    assert by_kind["reduce-scatter"][0]["bytes"] == 2 * 8 * 4
+    # async start: only the OUTPUT half of the tuple is the wire
+    assert by_kind["all-gather"][1]["async"]
+    assert by_kind["all-gather"][1]["bytes"] == 24 * 16 * 4
+    # replica groups: iota and explicit-list syntaxes both parse
+    assert by_kind["all-reduce"][0]["replica_groups"] == (1, 8)
+    assert by_kind["all-gather"][0]["replica_groups"] == (2, 4)
+    assert by_kind["all-to-all"][0]["replica_groups"] == (2, 4)
+
+
+def test_inventory_aggregation():
+    inv = shardprof.inventory_of(_HLO_FIXTURE)
+    assert inv["all-reduce"]["count"] == 2
+    assert inv["all-reduce"]["bytes"] == 16 * 8 * 4 + 4
+    assert (1, 8) in inv["all-reduce"]["replica_groups"]
+    assert inv["all-gather"]["count"] == 2
+    total = sum(d["bytes"] for d in inv.values())
+    assert total > 0
+
+
+# ---------------------------------------------------------------------------
+# The compile-hook ledger (note_program) + counters + fallback
+# ---------------------------------------------------------------------------
+
+class _FakeCompiled:
+    def __init__(self, text=None):
+        self._text = text
+
+    def as_text(self):
+        if self._text is None:
+            raise NotImplementedError("no HLO on this backend")
+        return self._text
+
+    def cost_analysis(self):
+        return {"flops": 100.0, "bytes accessed": 4096.0}
+
+
+def test_note_program_ledger_and_counters(fresh):
+    c0 = telemetry.counter("spmd_collectives_total").value
+    b0 = telemetry.counter("spmd_collective_bytes_total").value
+    entry = shardprof.note_program("test.site", ("test.site", 1),
+                                   _FakeCompiled(_HLO_FIXTURE))
+    assert entry["source"] == "hlo" and entry["bytes"] > 0
+    assert shardprof.site_inventory("test.site")["collectives"]
+    assert telemetry.counter("spmd_collectives_total").value == c0 + 7
+    assert telemetry.counter("spmd_collective_bytes_total").value > b0
+    per_kind = telemetry.counter("spmd_collective_bytes_total",
+                                 kind="all-reduce").value
+    assert per_kind == 16 * 8 * 4 + 4
+    # a second compile of the same signature key replaces, not stacks
+    entry2 = shardprof.note_program("test.site", ("test.site", 1),
+                                    _FakeCompiled(_HLO_FIXTURE))
+    assert entry2["compiles"] == 2
+    assert len([k for k in shardprof.programs() if k[0] == "test.site"]) \
+        == 1
+
+
+def test_note_program_cost_analysis_fallback(fresh):
+    s0 = telemetry.counter("errors_swallowed_total",
+                           site="shardprof.hlo_text").value
+    entry = shardprof.note_program("test.fallback", ("test.fallback", 1),
+                                   _FakeCompiled(None))
+    assert entry["source"] == "cost_analysis"
+    assert entry["collectives"] == {}
+    assert entry["cost"] == {"bytes_accessed": 4096.0}
+    # the guarded parse failure is counted, not silent
+    assert telemetry.counter("errors_swallowed_total",
+                             site="shardprof.hlo_text").value == s0 + 1
+
+
+def test_disabled_records_nothing(fresh, monkeypatch):
+    monkeypatch.setenv("MXNET_SHARDPROF", "0")
+    assert shardprof.note_program("x", ("x", 1),
+                                  _FakeCompiled(_HLO_FIXTURE)) is None
+    assert shardprof.programs() == {}
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: FSDP Module step inventory + zero instrumentation compiles
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fsdp_module(spmd_arg="fsdp", n=64, d=24):
+    X = np.random.RandomState(0).randn(n, d).astype(np.float32)
+    y = (np.random.RandomState(1).rand(n) * 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             spmd=spmd_arg)
+    mod.init_params()
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)))
+    return mod, list(it)
+
+
+def test_fsdp_fit_step_inventory_nonempty_and_instrumentation_free(fresh):
+    mod, batches = _fsdp_module()
+    for b in batches:
+        mod._step(b)
+    inv = shardprof.site_inventory("module.fused_step")
+    assert inv is not None and inv["collectives"], \
+        "FSDP train step compiled with no collective inventory"
+    # the fsdp weight gather and a gradient reduction are both on the
+    # wire (the CPU SPMD partitioner lowers the reduce-scatter as
+    # all-reduce + slice, so accept either reduction form)
+    assert "all-gather" in inv["collectives"]
+    assert inv["collectives"]["all-gather"]["bytes"] > 0
+    assert ("reduce-scatter" in inv["collectives"]
+            or "all-reduce" in inv["collectives"])
+    assert inv["bytes"] > 0
+    assert shardprof.train_step_inventory()["site"] == "module.fused_step"
+
+    # the instrumentation itself adds ZERO compiles/retraces: query
+    # every surface, then keep training on the warm cache
+    c0 = xla_stats.compile_counts()
+    shardprof.audit(mod)
+    shardprof.comm_stats(gbps=8.0)
+    shardprof.snapshot()
+    buf = io.StringIO()
+    shardprof.report(out=buf)
+    for b in batches:
+        mod._step(b)
+    c1 = xla_stats.compile_counts()
+    assert c1["compiles"] == c0["compiles"], \
+        "communication instrumentation triggered a compile"
+    assert c1["retraces"] == c0["retraces"], \
+        "communication instrumentation triggered a retrace"
+
+
+# ---------------------------------------------------------------------------
+# Sharding audit: DP / FSDP / tensor fixtures + the mis-replication flag
+# ---------------------------------------------------------------------------
+
+def test_audit_ok_per_policy(fresh):
+    for spmd_arg in ("data_parallel", "fsdp",
+                     {"policy": "tensor", "model_axis": 2}):
+        mod, batches = _fsdp_module(spmd_arg=spmd_arg)
+        mod._step(batches[0])
+        aud = shardprof.audit(mod)
+        assert aud["flagged"] == [], \
+            "%s audit flagged %s" % (spmd_arg, aud["flagged"])
+        kinds = {r["kind"] for r in aud["rows"]}
+        assert {"param", "grad", "opt_state"} <= kinds
+        if spmd_arg == "data_parallel":
+            assert aud["sharded_bytes"] == 0
+            assert aud["replicated_bytes"] > 0
+        else:
+            assert aud["sharded_bytes"] > 0
+        assert aud["param_bytes_global"] > 0
+        g = telemetry.gauge("spmd_sharded_param_bytes").value
+        assert g == aud["sharded_bytes"]
+
+
+def test_audit_flags_misreplicated_param(fresh):
+    """The init_params bias-bug class: a param the policy shards that
+    silently ends up replicated must be named by the audit."""
+    import jax
+    mod, _batches = _fsdp_module()
+    pol = mod._spmd
+    w = mod._exec.arg_dict["fc1_weight"]
+    w._data = jax.device_put(np.asarray(w.asnumpy()), pol.replicated())
+    aud = shardprof.audit(mod)
+    flagged = {r["name"]: r for r in aud["rows"] if r["status"] != "ok"}
+    assert "fc1_weight" in flagged
+    assert flagged["fc1_weight"]["status"] == "replicated"
+    assert flagged["fc1_weight"]["kind"] == "param"
+    assert "fc1_weight" in aud["flagged"]
+    assert telemetry.gauge("spmd_replicated_param_bytes").value >= \
+        w._data.nbytes
+
+
+def test_audit_gluon_trainer(fresh):
+    from mxnet_tpu.gluon import nn, Trainer
+    net = nn.Dense(16, in_units=24)
+    net.initialize()
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, spmd="fsdp")
+    aud = shardprof.audit(trainer)
+    assert aud["policy"] == "fsdp"
+    assert aud["flagged"] == []
+    assert aud["sharded_bytes"] > 0
+
+
+def test_audit_plain_dict_with_policy(fresh):
+    import jax
+    import jax.numpy as jnp
+    pol = spmd.make_policy("fsdp")
+    good = jax.device_put(jnp.zeros((16, 8), jnp.float32),
+                          pol.param_sharding("w", (16, 8)))
+    bad = jax.device_put(jnp.zeros((16, 8), jnp.float32),
+                         pol.replicated())
+    aud = shardprof.audit({"good": good, "bad": bad}, policy=pol)
+    by_name = {r["name"]: r for r in aud["rows"]}
+    assert by_name["good"]["status"] == "ok"
+    assert by_name["bad"]["status"] == "replicated"
+
+
+# ---------------------------------------------------------------------------
+# Overlap / comm verdict
+# ---------------------------------------------------------------------------
+
+def test_comm_stats_prediction_and_overlap(fresh, monkeypatch):
+    shardprof.note_program("module.fused_step", ("module.fused_step", 1),
+                           _FakeCompiled(_HLO_FIXTURE))
+    # 10 steps of 10ms wall, 8ms sampled device time each
+    for _ in range(10):
+        stepprof.record_step({"device_compute": 0.002,
+                              "dispatch": 0.001}, 0.010)
+    stepprof.note_device_sample(0.008)
+    monkeypatch.setenv("MXNET_SHARDPROF_LINK_GBPS", "0.001")  # 1 MB/s
+    comm = shardprof.comm_stats()
+    assert comm is not None
+    assert comm["site"] == "module.fused_step"
+    assert comm["bytes_per_step"] == shardprof.site_inventory(
+        "module.fused_step")["bytes"]
+    expect_c = comm["bytes_per_step"] / 1e6
+    assert comm["predicted_comm_seconds"] == pytest.approx(expect_c)
+    assert 0.0 < comm["comm_fraction"] <= 1.0
+    assert comm["overlap_fraction"] is not None
+    assert 0.0 <= comm["overlap_fraction"] <= 1.0
+    assert telemetry.gauge("spmd_predicted_comm_seconds").value == \
+        pytest.approx(expect_c)
+    # explicit bandwidth argument wins over the env table
+    c2 = shardprof.comm_stats(gbps=2e-3)
+    assert c2["predicted_comm_seconds"] == pytest.approx(expect_c / 2)
+
+
+def test_comm_stats_none_without_inventory_or_bandwidth(fresh,
+                                                        monkeypatch):
+    assert shardprof.comm_stats() is None        # no inventory at all
+    shardprof.note_program("module.fused_step", ("module.fused_step", 1),
+                           _FakeCompiled(_HLO_FIXTURE))
+    monkeypatch.setenv("MXNET_SHARDPROF_LINK_GBPS", "0")
+    assert shardprof.comm_stats() is None        # no bandwidth figure
+
+
+def test_classify_comm_bound_fsdp_hint():
+    shares = {"device_compute": 0.7, "dispatch": 0.2, "data_wait": 0.1}
+    comm = {"comm_fraction": 0.6, "overlap_fraction": 0.1,
+            "dominant_kind": "all-gather", "param_gather_ratio": 1.05}
+    v, hint = stepprof.classify(shares, comm=comm)
+    assert v == "comm-bound"
+    assert "fsdp weight gather" in hint and "donation" in hint
+    assert "10%" in hint  # the overlap figure is in the hint
+
+
+def test_classify_comm_bound_allreduce_hint_and_threshold():
+    shares = {"device_compute": 0.9, "dispatch": 0.1}
+    # all-reduce-dominant inventory -> dp gradient-sync hint
+    comm = {"comm_fraction": 0.5, "dominant_kind": "all-reduce"}
+    v, hint = stepprof.classify(shares, comm=comm)
+    assert v == "comm-bound"
+    assert "gradient_compression" in hint
+    # small predicted comm never flips the verdict
+    v2, _ = stepprof.classify(shares, comm={"comm_fraction": 0.05,
+                                            "dominant_kind": "all-reduce"})
+    assert v2 == "compute-bound"
+    # no shares at all: a dominant comm figure still names the wire
+    v3, _ = stepprof.classify({}, comm={"comm_fraction": 0.8})
+    assert v3 == "comm-bound"
+    assert stepprof.classify({}, comm=None)[0] == "unknown"
+
+
+def test_live_verdict_is_comm_aware(fresh, monkeypatch):
+    shardprof.note_program("module.fused_step", ("module.fused_step", 1),
+                           _FakeCompiled(_HLO_FIXTURE))
+    for _ in range(4):
+        stepprof.record_step({"device_compute": 0.004}, 0.005)
+    # a wire so slow the predicted comm dwarfs the step -> comm-bound
+    monkeypatch.setenv("MXNET_SHARDPROF_LINK_GBPS", "1e-6")
+    v, _ = stepprof.verdict()
+    assert v == "comm-bound"
+
+
+# ---------------------------------------------------------------------------
+# Snapshots, cross-host merge, report CLI
+# ---------------------------------------------------------------------------
+
+def _fake_snapshot(host, comm_seconds, flagged=()):
+    return {"host": host, "pid": 1000 + host, "updated": 1e9 + host,
+            "sites": {}, "steps": 4,
+            "totals": {"all-gather": {"count": 2,
+                                      "bytes": 1024 * (host + 1)}},
+            "comm": {"site": "module.fused_step",
+                     "bytes_per_step": 1024 * (host + 1),
+                     "by_kind": {"all-gather": 1024 * (host + 1)},
+                     "dominant_kind": "all-gather",
+                     "predicted_comm_seconds": comm_seconds,
+                     "link_gbps": 8.0, "step_seconds": 0.01,
+                     "comm_fraction": 0.5, "overlap_fraction": 0.25,
+                     "param_gather_ratio": 1.0},
+            "audit": {"policy": "fsdp", "flagged": list(flagged),
+                      "replicated_bytes": 64, "sharded_bytes": 4096,
+                      "rows": 6, "bad_rows": []}}
+
+
+def test_write_and_merge_host_snapshots(fresh, tmp_path):
+    shardprof.note_program("test.site", ("test.site", 1),
+                           _FakeCompiled(_HLO_FIXTURE))
+    path = shardprof.write_host_snapshot(str(tmp_path), force=True)
+    assert path and os.path.exists(path)
+    merged = shardprof.merge_host_snapshots(str(tmp_path))
+    assert telemetry.host_id() in merged
+    doc = merged[telemetry.host_id()]
+    assert doc["totals"]["all-reduce"]["bytes"] > 0
+
+
+def test_report_cli_host_dir_roundtrip(fresh, tmp_path, capsys):
+    for host, secs in ((0, 0.001), (1, 0.004)):
+        with open(os.path.join(str(tmp_path),
+                               "shardprof_host%d_pid1.json" % host),
+                  "w") as fh:
+            json.dump(_fake_snapshot(host, secs,
+                                     flagged=["fc1_bias"] if host else []),
+                      fh)
+    rc = shardprof.main(["report", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "all-gather" in out
+    assert "comm skew" in out and "slow host 1" in out
+    assert "audit[fsdp]" in out and "fc1_bias" in out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["metric"] == "shardprof_report"
+    assert rec["comm_skew_seconds"] == pytest.approx(0.003)
+    assert rec["audit_flagged"] == 1
+    # the skew helper names the slow host and publishes the gauge
+    sk = shardprof.comm_skew(str(tmp_path))
+    assert sk["slow_host"] == 1
+    assert sk["skew_seconds"] == pytest.approx(0.003)
+    assert telemetry.gauge("spmd_comm_skew_seconds").value == \
+        pytest.approx(0.003)
+
+
+def test_report_cli_no_data_exits_1(fresh, tmp_path, capsys):
+    rc = shardprof.main(["report", str(tmp_path), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["collectives"] == {}
+
+
+def test_report_single_snapshot_file(fresh, tmp_path, capsys):
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps(_fake_snapshot(3, 0.002)))
+    rc = shardprof.main(["report", str(p)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "comm share: 50%" in out and "overlap 25%" in out
+    assert "verdict: comm-bound" in out
+
+
+# ---------------------------------------------------------------------------
+# Speedometer comm suffix (gated like the phase summary)
+# ---------------------------------------------------------------------------
+
+def test_speedometer_comm_suffix_gated(fresh, monkeypatch):
+    sp = mx.callback.Speedometer(batch_size=16, frequent=4)
+    shardprof.note_program("module.fused_step", ("module.fused_step", 1),
+                           _FakeCompiled(_HLO_FIXTURE))
+    for _ in range(4):
+        stepprof.record_step({"device_compute": 0.004}, 0.005)
+    monkeypatch.setenv("MXNET_SHARDPROF_LINK_GBPS", "0.001")
+    assert sp._comm_suffix() == ""          # disabled: no suffix
+    stepprof.enable()
+    try:
+        suffix = sp._comm_suffix()
+        assert "comm" in suffix and "%" in suffix
+    finally:
+        stepprof.disable()
+
+
+# ---------------------------------------------------------------------------
+# Bench wiring: scaling record attribution + bench_gate comm delta
+# ---------------------------------------------------------------------------
+
+def test_scaling_record_carries_comm_attribution(fresh):
+    sys.path.insert(0, REPO)
+    import __graft_entry__ as graft
+    rec = graft.scaling_efficiency_record(8, batch_per_device=8, steps=2)
+    assert rec["metric"] == "multichip_scaling_efficiency"
+    assert rec["value"] > 0
+    assert rec["collectives"], "scaling record carries no collectives"
+    assert all(d["bytes"] >= 0 for d in rec["collectives"].values())
+    assert rec["comm_bytes_per_step"] > 0
+    assert rec["audit"]["policy"] == "data_parallel"
+    assert rec["audit"]["flagged"] == 0
+
+
+def test_bench_gate_comm_delta_line(tmp_path):
+    d = str(tmp_path)
+    hist = {"metric": bench_gate.MULTICHIP_METRIC, "value": 0.9,
+            "n_devices": 8,
+            "collectives": {"all-reduce": {"count": 4, "bytes": 4096},
+                            "all-gather": {"count": 3, "bytes": 1024}}}
+    with open(os.path.join(d, "MULTICHIP_r01.json"), "w") as fh:
+        json.dump({"n_devices": 8, "ok": True,
+                   "tail": json.dumps(hist) + "\n"}, fh)
+    run = [{"metric": bench_gate.MULTICHIP_METRIC, "value": 0.5,
+            "collectives": {"all-reduce": {"count": 4, "bytes": 4096},
+                            "all-gather": {"count": 6, "bytes": 9216}}}]
+    out = io.StringIO()
+    rc = bench_gate.gate_records(run, history_dir=d,
+                                 metric=bench_gate.MULTICHIP_METRIC,
+                                 out=out)
+    assert rc == 1
+    lines = [json.loads(l) for l in out.getvalue().splitlines()]
+    comm_lines = [l for l in lines if l["metric"] == "bench_gate_comm"]
+    assert len(comm_lines) == 1
+    cl = comm_lines[0]
+    assert cl["delta"]["all-gather"] == pytest.approx(8192)
+    assert cl["delta"]["all-reduce"] == pytest.approx(0)
+    assert "all-gather +8192 B/step" in cl["detail"]
+    # a passing run prints no delta line
+    out2 = io.StringIO()
+    ok = [{"metric": bench_gate.MULTICHIP_METRIC, "value": 0.88}]
+    assert bench_gate.gate_records(
+        ok, history_dir=d, metric=bench_gate.MULTICHIP_METRIC,
+        out=out2) == 0
+    assert "bench_gate_comm" not in out2.getvalue()
+
+
+def test_bench_gate_comm_delta_without_run_inventory(tmp_path):
+    d = str(tmp_path)
+    hist = {"metric": bench_gate.MULTICHIP_METRIC, "value": 0.9,
+            "collectives": {"all-reduce": {"count": 4, "bytes": 4096}}}
+    with open(os.path.join(d, "MULTICHIP_r01.json"), "w") as fh:
+        json.dump({"n_devices": 8, "ok": True,
+                   "tail": json.dumps(hist) + "\n"}, fh)
+    out = io.StringIO()
+    rc = bench_gate.gate_records(
+        [{"metric": bench_gate.MULTICHIP_METRIC, "value": 0.5}],
+        history_dir=d, metric=bench_gate.MULTICHIP_METRIC, out=out)
+    assert rc == 1
+    lines = [json.loads(l) for l in out.getvalue().splitlines()]
+    cl = [l for l in lines if l["metric"] == "bench_gate_comm"][0]
+    assert "no collective inventory in this run" in cl["detail"]
+
+
+def test_repo_gate_multichip_comm_history_present():
+    """The checked-in MULTICHIP history now carries at least one round
+    with the scaling metric line in its tail (the empty-tail fix), so
+    repo_gate's multichip lane has something to gate against."""
+    hist = bench_gate.load_history(REPO)
+    assert bench_gate.MULTICHIP_METRIC in hist, \
+        "no MULTICHIP round in repo history carries the metric line"

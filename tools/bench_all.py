@@ -9,6 +9,10 @@ Configs (BASELINE.md "North-star target" reproduction list):
   - lstm_ptb         word-LM tokens/s train (example/rnn/word_lm)
   - sparse_fm        factorization machine samples/s (example/sparse)
   - wide_deep        wide&deep samples/s (example/sparse)
+  - multichip        SPMD weak-scaling efficiency on a forced 8-device
+                     CPU mesh, with the shardprof collective inventory
+                     (bytes/step by kind), overlap_fraction, and the
+                     sharding-audit summary attached to the record
 
 Usage:
     python tools/bench_all.py                 # all configs, TPU default
@@ -156,6 +160,46 @@ def bench_wide_deep():
                          12000, 2, ["--num-sparse", "1000"])
 
 
+def bench_multichip(n_devices=8):
+    """The `multichip_scaling_efficiency` record on a forced N-device
+    CPU mesh (a subprocess: the device count must be set before jax
+    initializes a backend). Carries the communication anatomy —
+    collective bytes/step by kind, overlap_fraction, sharding-audit
+    summary — so MULTICHIP history gates with attribution."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            "count=%d" % n_devices).strip()
+    # the axon TPU sitecustomize overrides JAX_PLATFORMS at interpreter
+    # startup, so the child must ALSO drop the plugin's backend factory
+    # before any backend initializes (same trick as dryrun_multichip /
+    # tests/conftest.py) — the env var alone is too late on a TPU host
+    code = ("import jax\n"
+            "try:\n"
+            "    jax.config.update('jax_platforms', 'cpu')\n"
+            "    from jax._src import xla_bridge as _xb\n"
+            "    _xb._backend_factories.pop('axon', None)\n"
+            "except Exception:\n"
+            "    pass\n"
+            "import json, __graft_entry__ as g\n"
+            "print(json.dumps(g.scaling_efficiency_record(%d)))\n"
+            % n_devices)
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=1200)
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "multichip_scaling_efficiency":
+                return rec
+    raise RuntimeError("multichip bench produced no record:\n"
+                       + r.stdout[-1500:] + r.stderr[-1500:])
+
+
 CONFIGS = {
     "resnet50_infer": bench_resnet50_infer,
     "resnet50_train": bench_resnet50_train,
@@ -163,6 +207,7 @@ CONFIGS = {
     "lstm_ptb_bf16": bench_lstm_ptb_bf16,
     "sparse_fm": bench_sparse_fm,
     "wide_deep": bench_wide_deep,
+    "multichip": bench_multichip,
 }
 
 

@@ -20,7 +20,12 @@ from .core import (RULES, analyze_paths, repo_root,
 DEFAULT_PATHS = ["mxnet_tpu"]
 
 
-def gate_line(status, detail, out=sys.stdout, **extra):
+def gate_line(status, detail, out=None, **extra):
+    # out resolves to the CURRENT sys.stdout per call (same lesson as
+    # bench_gate.gate_records): a module-level default would bind
+    # whatever capture stream was live at first import and break every
+    # later redirected caller
+    out = out if out is not None else sys.stdout
     rec = dict({"metric": "mxanalyze_gate", "status": status,
                 "detail": detail}, **extra)
     out.write(json.dumps(rec) + "\n")

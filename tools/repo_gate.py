@@ -12,7 +12,10 @@ Runs the two gates that share exit-code conventions (0 = pass,
   the TRAIN/INFER headline as before, PLUS the serving-latency gate
   (lower-is-better ``serving_closed_p99_ms``) whenever the run carries
   serving records, so ``bench.py --serve`` output gates its tail
-  latency through the same entry point.
+  latency through the same entry point, PLUS the multichip comm gate
+  (``multichip_scaling_efficiency`` vs MULTICHIP_*.json history, a
+  ``bench_gate_comm`` bytes-by-kind delta line on regression) whenever
+  the run carries MULTICHIP records.
 
 Usage:
     python tools/repo_gate.py                     # analysis only
