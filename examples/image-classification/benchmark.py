@@ -202,11 +202,15 @@ def _bench_phase_breakdown(args, mod, batches, att_calls=2):
     BENCH history carries attribution)."""
     import json
     import numpy as np
-    from mxnet_tpu import stepprof, telemetry
+    from mxnet_tpu import runprof, stepprof, telemetry
 
     K = args.batches_per_dispatch
     stepprof.enable(sync_every=1)
     stepprof.reset()
+    # run anatomy over the attribution window only: compile/warmup
+    # already happened, so the goodput fraction recorded with the TRAIN
+    # metric reflects steady-state training, not this process's startup
+    runprof.reset()
     for _ in range(max(1, att_calls)):
         with stepprof.step(batches=K):
             if K > 1:
@@ -229,11 +233,17 @@ def _bench_phase_breakdown(args, mod, batches, att_calls=2):
         shares, retraces=retr.value if retr else 0,
         fused=mod._fused_plan is not False,
         donated=bool(getattr(mod, "scan_donate_params", False)))
+    run_snap = runprof.snapshot()
     print(json.dumps({
         "metric": "train_phase_breakdown", "unit": "share",
         "phases": {k: round(v, 4) for k, v in shares.items()},
-        "verdict": verdict, "hint": hint}), flush=True)
+        "verdict": verdict, "hint": hint,
+        "goodput_fraction": round(run_snap["goodput_fraction"], 4),
+        "run_states": {k: round(v, 4)
+                       for k, v in run_snap["states"].items()}}),
+        flush=True)
     stepprof.write_host_snapshot(force=True)  # telemetry dir, if armed
+    runprof.write_host_snapshot(force=True)
 
 
 if __name__ == "__main__":

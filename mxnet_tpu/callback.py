@@ -39,7 +39,27 @@ def elastic_checkpoint(manager, mod, period=1):
     never restored (no COMMIT marker)."""
     period = int(max(1, period))
 
+    last_call = {"t": None}
+
     def _callback(iter_no, sym=None, arg=None, aux=None):
+        # run anatomy: the high-water progress marker (EPOCH units
+        # here) prices the rework a crashed incarnation forces on its
+        # resume; the marker's mean must be seconds-per-EPOCH, so it is
+        # measured as the wall between epoch-end calls (unknown on the
+        # first epoch — better unpriced than priced per batch)
+        now = time.perf_counter()
+        epoch_seconds = (now - last_call["t"]) \
+            if last_call["t"] is not None else None
+        last_call["t"] = now
+        try:
+            from . import runprof
+            runprof.note_progress(iter_no + 1,
+                                  step_seconds=epoch_seconds,
+                                  scope=manager.root)
+        except Exception as exc:
+            # the ledger must never take the checkpoint save down
+            from . import telemetry
+            telemetry.swallowed("callback.runprof", exc)
         if (iter_no + 1) % period == 0:
             from .parallel import elastic as _elastic
             _elastic.save_module(manager, iter_no + 1, mod)
@@ -94,7 +114,9 @@ class Speedometer:
         self._samples_tic = self._registry_samples()
         self._batches_tic = self._registry_batches()
         from . import stepprof
+        from . import runprof
         self._phase_tic = stepprof.totals()
+        self._goodput_tic = runprof.state_seconds("train_productive")
 
     def _speed(self):
         elapsed = time.time() - self.tic
@@ -143,6 +165,22 @@ class Speedometer:
                  if delta.get(name, 0.0) / total >= 0.01]
         return "\t" + " | ".join(parts) if parts else ""
 
+    def _runprof_suffix(self):
+        """"\\tgoodput X%" — the run-state ledger's productive share of
+        the window since the last mark (`runprof`). Gated by
+        MXNET_STEPPROF like the phase summary; "" when disabled or no
+        productive seconds advanced."""
+        from . import stepprof
+        if not stepprof.enabled():
+            return ""
+        from . import runprof
+        elapsed = time.time() - self.tic
+        done = runprof.state_seconds("train_productive") - \
+            getattr(self, "_goodput_tic", 0.0)
+        if elapsed <= 0 or done <= 0:
+            return ""
+        return "\tgoodput %.0f%%" % (min(1.0, done / elapsed) * 100.0)
+
     def _comm_suffix(self):
         """"\\tcomm X% | overlap Y%" — predicted collective share of the
         step wall and the estimated fraction of it hidden under compute
@@ -176,7 +214,8 @@ class Speedometer:
             if count % self.frequent == 0:
                 speed = self._speed()
                 goodput = self._goodput_suffix()
-                phases = self._phase_suffix() + self._comm_suffix()
+                phases = self._phase_suffix() + self._comm_suffix() \
+                    + self._runprof_suffix()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

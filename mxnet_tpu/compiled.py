@@ -447,6 +447,13 @@ class CompiledProgram:
                                  "(%s); deferring to jit dispatch",
                                  self.site, exc)
             dur = time.perf_counter() - t0
+            try:
+                # run anatomy: compile wall is badput the run-state
+                # ledger accounts against training goodput
+                from . import runprof
+                runprof.note_state("compile", dur, site=self.site)
+            except Exception as exc:
+                telemetry.swallowed("compiled.runprof", exc)
             flops = _flops_of(compiled) if compiled is not None else None
             memory = _memory_of(compiled) if compiled is not None else None
             telemetry.histogram("jit_compile_seconds",

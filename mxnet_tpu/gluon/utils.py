@@ -100,6 +100,18 @@ def clip_global_norm(arrays, max_norm):
         import warnings
         warnings.warn(UserWarning("nan or inf is detected. Clipping results "
                                   "will be undefined."), stacklevel=2)
+        # divergence must be countable, not just printable: the counter
+        # survives scrollback and the sentinel dumps the flight recorder
+        # (MXNET_RUNPROF_HALT=1 additionally stops the run)
+        from .. import runprof, telemetry
+        telemetry.counter(
+            "grad_nonfinite_total",
+            help="non-finite global gradient norms observed by "
+                 "clip_global_norm").inc()
+        runprof.note_anomaly("nonfinite_grad_norm",
+                             detail="clip_global_norm over %d arrays"
+                                    % len(arrays),
+                             value=float(total_norm))
     scale = max_norm / (total_norm + 1e-8)
     if scale < 1.0:
         for arr in arrays:

@@ -15,6 +15,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import io as io_mod
+from .. import runprof
 from .. import stepprof
 from .. import telemetry
 from ..ndarray import NDArray
@@ -28,9 +29,13 @@ class BatchEndParam:
         self.locals = locals
 
 
-def _count_fit_batch(batch):
+def _count_fit_batch(batch, eval_metric=None):
     """Per-batch throughput series: `callback.Speedometer` reads its
-    samples/sec from these counters instead of recomputing locally."""
+    samples/sec from these counters instead of recomputing locally.
+    Every ``MXNET_RUNPROF_CHECK_EVERY``-th batch also sweeps the
+    metric values through the training-health sentinels (`runprof`):
+    a NaN/Inf loss trips ``run_anomalies_total`` + a flight-recorder
+    dump instead of burning hours unnoticed."""
     try:
         samples = int(batch.data[0].shape[0])
     except Exception as exc:  # exotic batch payloads still count batches
@@ -42,6 +47,13 @@ def _count_fit_batch(batch):
         telemetry.counter("fit_samples_total",
                           help="train samples completed by Module.fit"
                           ).inc(samples)
+    if eval_metric is not None and runprof.should_check():
+        try:
+            runprof.observe_metrics(eval_metric.get_name_value())
+        except runprof.RunHealthError:
+            raise   # MXNET_RUNPROF_HALT: a tripped sentinel stops fit
+        except Exception as exc:  # a broken metric must not stop fit
+            telemetry.swallowed("fit.health_check", exc)
 
 
 def _as_list(obj):
@@ -239,6 +251,11 @@ class BaseModule:
                 commit_timeout=cfg.get("commit_timeout"))
             resumed = elastic_mod.restore_module(ckpt, self)
             if resumed is not None:
+                # run anatomy: price the epochs the previous incarnation
+                # trained past this checkpoint (lost work on a restart).
+                # Only on a REAL resume — a fresh run must not read a
+                # previous run's leftover marker as phantom loss.
+                runprof.note_resume(resumed, scope=ckpt.root)
                 # checkpoint step == number of completed epochs
                 begin_epoch = max(begin_epoch, resumed)
                 self.logger.info("elastic: resumed from checkpoint; "
@@ -343,7 +360,7 @@ class BaseModule:
                                 else:
                                     self.update_metric(eval_metric,
                                                        b.label)
-                            _count_fit_batch(b)
+                            _count_fit_batch(b, eval_metric)
                             if batch_end_callback is not None:
                                 batch_end_params = BatchEndParam(
                                     epoch=epoch, nbatch=nbatch,
@@ -373,7 +390,7 @@ class BaseModule:
                     with stepprof.phase("device_compute",
                                         via="update_metric"):
                         self.update_metric(eval_metric, data_batch.label)
-                _count_fit_batch(data_batch)
+                _count_fit_batch(data_batch, eval_metric)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

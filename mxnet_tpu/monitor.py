@@ -1,17 +1,34 @@
 """Monitor: tensor-stat spying during execution.
 
 Parity with reference `python/mxnet/monitor.py` (install on executor monitor
-callback, tic/toc, stat_func).
+callback, tic/toc, stat_func). The reference's canonical use is NaN-hunting
+mid-run; here that workflow is wired into the run-anatomy counters: pass
+:func:`nan_count` as the ``stat_func`` and every tensor with non-finite
+entries bumps ``run_anomalies_total{kind="nonfinite_tensor"}`` (and dumps
+the flight recorder) the moment `toc` reads it — with the default
+``asum_stat``, a non-finite mean is routed the same way.
 """
 from __future__ import annotations
 
 import logging
+import math
 import re
 
-from . import telemetry
-from .ndarray import NDArray
+import numpy as np
 
-__all__ = ["Monitor"]
+from . import telemetry
+from .ndarray import NDArray, array
+
+__all__ = ["Monitor", "nan_count"]
+
+
+def nan_count(x):
+    """Stat func counting the non-finite (NaN/Inf) entries of a tensor —
+    the reference Monitor's NaN-hunting sweep as a number. Returns a
+    1-element NDArray so `Monitor.toc` renders it like any stat."""
+    v = np.asarray(x.asnumpy())
+    bad = v.size - int(np.count_nonzero(np.isfinite(v)))
+    return array(np.array([bad], dtype="float32"))
 
 
 class Monitor:
@@ -56,6 +73,24 @@ class Monitor:
             self.activated = True
         self.step += 1
 
+    def _flag_nonfinite(self, name, value):
+        """Route a monitor-observed unhealthy tensor into the run-
+        anatomy sentinels: with :func:`nan_count` any nonzero count is
+        a hit; with value stats a non-finite result is (a finite mean
+        of a NaN-carrying tensor cannot exist, so the routes agree)."""
+        try:
+            f = float(value)
+        except (TypeError, ValueError):
+            return
+        bad = f > 0 if self.stat_func is nan_count \
+            else not math.isfinite(f)
+        if bad:
+            from . import runprof
+            runprof.note_anomaly(
+                "nonfinite_tensor",
+                detail="monitor stat %s at batch %d" % (name, self.step),
+                value=f)
+
     def toc(self):
         if not self.activated:
             return []
@@ -63,17 +98,26 @@ class Monitor:
         res = []
         if self.sort:
             self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            s = ""
-            for v in v_list:
-                if v.size == 1:
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
-        self.queue = []
+        try:
+            for n, k, v_list in self.queue:
+                if isinstance(v_list, NDArray):
+                    v_list = [v_list]
+                s = ""
+                for v in v_list:
+                    if v.size == 1:
+                        val = v.asscalar()
+                        s += str(val) + "\t"
+                        self._flag_nonfinite(k, val)
+                    else:
+                        a = v.asnumpy()
+                        s += str(a) + "\t"
+                        if not np.isfinite(a).all():
+                            self._flag_nonfinite(k, float("nan"))
+                res.append((n, k, s))
+        finally:
+            # also on a sentinel halt mid-loop: stale entries must not
+            # be re-flagged (and re-raised) by the next toc
+            self.queue = []
         return res
 
     def toc_print(self):

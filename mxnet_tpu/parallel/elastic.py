@@ -64,6 +64,29 @@ class WorkerFailure(RuntimeError):
     """Peer loss detected via the heartbeat protocol mid-run."""
 
 
+def _stepprof_steps():
+    """Process stepprof step count (0 when unreadable) — the elastic
+    loop uses the delta across one step_fn call to tell raw step
+    functions (book them here) from stepprof-instrumented ones (already
+    booked by `stepprof._record`'s runprof hook)."""
+    try:
+        from .. import stepprof
+        return stepprof.profiler.steps_recorded()
+    except Exception as exc:
+        telemetry.swallowed("elastic.runprof", exc)
+        return 0
+
+
+def _note_run_state(state, seconds, **attrs):
+    """Best-effort run-anatomy ledger note (`mxnet_tpu.runprof`) — the
+    ledger must never take a checkpoint or recovery path down."""
+    try:
+        from .. import runprof
+        runprof.note_state(state, seconds, **attrs)
+    except Exception as exc:
+        telemetry.swallowed("elastic.runprof", exc)
+
+
 def _flight_dump(reason, error=None):
     """Best-effort flight-recorder dump before an ``os._exit`` — the
     post-mortem must survive even when xla_stats cannot import."""
@@ -182,8 +205,13 @@ class ElasticCheckpointer:
         same marker.
         """
         step = int(step)
-        with telemetry.span("elastic.checkpoint.save", step=step):
-            return self._save_impl(step, tree, aux)
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("elastic.checkpoint.save", step=step):
+                return self._save_impl(step, tree, aux)
+        finally:
+            _note_run_state("checkpoint_save",
+                            time.perf_counter() - t0, step=step)
 
     def _save_impl(self, step, tree, aux):
         if self._resolved_backend() == "local":
@@ -229,8 +257,13 @@ class ElasticCheckpointer:
     def restore(self, template, step=None):
         """Load checkpoint ``step`` (default: latest complete) onto the
         placements in ``template``. Returns ``(step, tree)``."""
-        with telemetry.span("elastic.checkpoint.restore", step=step):
-            return self._restore_impl(template, step)
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("elastic.checkpoint.restore", step=step):
+                return self._restore_impl(template, step)
+        finally:
+            _note_run_state("checkpoint_restore",
+                            time.perf_counter() - t0, step=step)
 
     def _restore_impl(self, template, step):
         if step is None:
@@ -418,11 +451,45 @@ class ElasticTrainer:
             if self.on_restore is not None:
                 tree = self.on_restore(tree)
             logging.info("elastic: resumed from checkpoint step %d", step)
+            try:
+                # run anatomy: price the rework between this checkpoint
+                # and wherever the previous incarnation died (markers
+                # scoped to this checkpoint root)
+                from .. import runprof
+                runprof.note_resume(step, scope=self.ckpt.root)
+            except Exception as exc:
+                telemetry.swallowed("elastic.runprof", exc)
             return step, tree
         return 0, self._state0
 
     # -- recovery ---------------------------------------------------------
     def _recover(self, state, exc):
+        t0 = time.perf_counter()
+        try:
+            return self._recover_impl(state, exc)
+        finally:
+            # run anatomy: the recover cycle (backoff + reattach) is
+            # recovery badput; the restore inside it already booked
+            # itself as checkpoint_restore, so carve that out
+            try:
+                from .. import runprof
+                dur = time.perf_counter() - t0
+                restored = runprof.state_seconds("checkpoint_restore") \
+                    - self._restore_seconds_at_recover
+                runprof.note_state(
+                    "recovery", max(0.0, dur - max(0.0, restored)),
+                    restart=self.restarts_used)
+            except Exception as exc2:
+                telemetry.swallowed("elastic.runprof", exc2)
+
+    def _recover_impl(self, state, exc):
+        try:
+            from .. import runprof
+            self._restore_seconds_at_recover = \
+                runprof.state_seconds("checkpoint_restore")
+        except Exception as exc2:
+            telemetry.swallowed("elastic.runprof", exc2)
+            self._restore_seconds_at_recover = 0.0
         self.restarts_used += 1
         telemetry.counter("elastic_recoveries_total",
                           help="in-process recover cycles entered").inc()
@@ -463,6 +530,7 @@ class ElasticTrainer:
 
     # -- main loop --------------------------------------------------------
     def run(self, num_steps):
+        from .. import runprof
         step, state = self._restore_latest(self._state0)
         self.resumed_from = step if step else None
         start_step = step
@@ -473,8 +541,17 @@ class ElasticTrainer:
                 try:
                     self._check_peers(step)
                     chaos.maybe_step_fail(step)
+                    steps_before = _stepprof_steps()
+                    t_step = time.perf_counter()
                     state = self.step_fn(state, step)
+                    step_dur = time.perf_counter() - t_step
                 except (KeyboardInterrupt, SystemExit):
+                    raise
+                except runprof.RunHealthError:
+                    # MXNET_RUNPROF_HALT tripped INSIDE step_fn (a
+                    # stepprof-instrumented step, clip_global_norm):
+                    # a halt is a verdict, not a worker failure —
+                    # restarting would re-trip it all restart budget
                     raise
                 except Exception as exc:
                     if self.on_failure == "exit":
@@ -491,6 +568,25 @@ class ElasticTrainer:
                     step, state = self._recover(state, exc)
                     continue
                 step += 1
+                try:
+                    # run anatomy: feed the ledger (productive seconds +
+                    # spike sentinel) for raw step functions — a step_fn
+                    # that already went through the process stepprof
+                    # profiler (Module/gluon APIs, an explicit
+                    # stepprof.step bracket) booked itself there, and
+                    # booking it twice would break the states-tile-the-
+                    # wall invariant — and always advance the progress
+                    # marker (lost-work pricing on the next resume,
+                    # scoped to this checkpoint root)
+                    if _stepprof_steps() == steps_before:
+                        runprof.note_step({}, step_dur)
+                    runprof.note_progress(
+                        step, step_seconds=step_dur,
+                        scope=self.ckpt.root if self.ckpt else None)
+                except runprof.RunHealthError:
+                    raise   # MXNET_RUNPROF_HALT: a spike halts the run
+                except Exception as exc:
+                    telemetry.swallowed("elastic.runprof", exc)
                 if self.ckpt_every and step % self.ckpt_every == 0:
                     self._save(step, state)
         finally:
@@ -667,7 +763,12 @@ def supervise(worker_argv, nprocs, max_restarts=3, env=None, log_dir=None,
                         "relaunching pod" if restart < max_restarts
                         else "out of restarts")
         if restart < max_restarts:
+            t0 = time.monotonic()
             _retry_mod._sleep(policy.delay_for(restart + 1))
+            # run anatomy: pod-relaunch backoff is recovery badput in
+            # the supervisor's ledger (workers book their own restore)
+            _note_run_state("recovery", time.monotonic() - t0,
+                            round=restart, site="supervise")
     raise RetryError("elastic supervise: all %d rounds failed (last: %s); "
                      "logs in %s" % (max_restarts + 1, last_fail, log_dir),
                      max_restarts + 1)

@@ -143,14 +143,7 @@ def _env_flag(name, default="0"):
     return os.environ.get(name, default) not in ("0", "", "false")
 
 
-def _env_int(name, default):
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        import warnings
-        warnings.warn("bad %s=%r ignored (want an integer)"
-                      % (name, os.environ[name]))
-        return default
+_env_int = telemetry.env_int
 
 
 class _Phase:
@@ -302,6 +295,20 @@ class StepProfiler:
                 self._totals[name] = self._totals.get(name, 0.0) + dur
             self._totals[PHASE_OTHER] = \
                 self._totals.get(PHASE_OTHER, 0.0) + other
+        if self is profiler:
+            # run anatomy: the PROCESS profiler's steps feed the
+            # run-state ledger (data_wait -> input_stall, the rest ->
+            # train_productive) and its spike sentinel; private test
+            # instances stay out of the run's books
+            runprof = None
+            try:
+                from . import runprof
+                runprof.note_step(phases, wall, batches=rec["batches"])
+            except Exception as exc:
+                if runprof is not None and \
+                        isinstance(exc, runprof.RunHealthError):
+                    raise   # MXNET_RUNPROF_HALT: the spike stops the run
+                telemetry.swallowed("stepprof.runprof", exc)
         self._maybe_export()
 
     def reset(self):
@@ -353,6 +360,13 @@ class StepProfiler:
             return {}
         return {name: vals.get(name, 0.0) / denom
                 for name in PHASES + (PHASE_OTHER,)}
+
+    def steps_recorded(self):
+        """Cheap step count (no window copy/sort — hot-path callers
+        like the elastic loop's per-step delta read this, not
+        :meth:`step_stats`)."""
+        with self._lock:
+            return self._steps
 
     def step_stats(self):
         with self._lock:

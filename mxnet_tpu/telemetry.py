@@ -57,7 +57,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "flush",
            "write_snapshot", "host_id", "set_host_id", "read_events",
            "to_chrome", "merge", "add_tap", "remove_tap", "swallowed",
-           "write_host_json", "merge_host_json"]
+           "write_host_json", "merge_host_json", "env_int", "env_float"]
 
 _logger = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -79,6 +79,27 @@ _NAME_SANE = re.compile(r"[^a-zA-Z0-9_:]")
 
 def _sanitize(name):
     return _NAME_SANE.sub("_", name)
+
+
+def _env_num(name, default, parse):
+    try:
+        return parse(os.environ.get(name, "") or default)
+    except ValueError:
+        import warnings
+        warnings.warn("bad %s=%r ignored (want a number)"
+                      % (name, os.environ[name]))
+        return parse(default)
+
+
+def env_int(name, default):
+    """``int(os.environ[name])`` with warn-and-default on garbage — the
+    one knob parser the observability modules share."""
+    return _env_num(name, default, int)
+
+
+def env_float(name, default):
+    """:func:`env_int`'s float sibling."""
+    return _env_num(name, default, float)
 
 
 # ---------------------------------------------------------------------------

@@ -109,6 +109,14 @@ def bench_resnet50_train():
     if pb:
         rec["phases"] = pb.get("phases") or {}
         rec["verdict"] = pb.get("verdict")
+        # run anatomy: goodput fraction + run-state seconds over the
+        # attribution window, gated by bench_gate as
+        # train_goodput_fraction (higher is better) with a state-
+        # seconds delta line on regression
+        if isinstance(pb.get("goodput_fraction"), (int, float)):
+            rec["goodput_fraction"] = pb["goodput_fraction"]
+        if isinstance(pb.get("run_states"), dict):
+            rec["run_states"] = pb["run_states"]
     return rec
 
 

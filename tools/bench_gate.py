@@ -51,6 +51,12 @@ TRAIN_METRIC = "resnet50_train_imgs_per_sec_bf16_bs128"
 INFER_METRIC = "resnet50_infer_imgs_per_sec_bs32"
 SERVE_METRIC = "serving_closed_p99_ms"
 MULTICHIP_METRIC = "multichip_scaling_efficiency"
+#: run-anatomy goodput fraction (higher is better). Carried as a
+#: ``goodput_fraction`` field on the TRAIN record (bench_all folds the
+#: attribution pass in); both that field and standalone records under
+#: this name gate, and a regression prints a ``bench_gate_states``
+#: state-seconds delta line (the run-state analog of the phase deltas).
+GOODPUT_METRIC = "train_goodput_fraction"
 DEFAULT_THRESHOLD = 0.10
 #: the multichip weak-scaling ratio is measured on a forced-CPU virtual
 #: mesh whose run-to-run spread is ~+-15%; gating it at the default 10%
@@ -92,16 +98,21 @@ def _numeric(v):
 def load_history(history_dir=None, with_phases=False):
     """{metric: [(value, source), ...]} from the recorded rounds.
 
-    ``with_phases=True`` returns ``(history, phases, comm)`` where
-    ``phases`` maps ``(metric, source)`` to the ``"phases"`` share dict
-    of the best record that source saw (absent for rounds recorded
-    before the step-time profiler existed) and ``comm`` likewise maps to
+    ``with_phases=True`` returns ``(history, phases, comm, states)``
+    where ``phases`` maps ``(metric, source)`` to the ``"phases"`` share
+    dict of the best record that source saw (absent for rounds recorded
+    before the step-time profiler existed), ``comm`` likewise maps to
     the best record's ``"collectives"`` inventory (bytes/step by kind —
-    absent before the communication profiler existed)."""
+    absent before the communication profiler existed), and ``states``
+    to the best record's ``"run_states"`` seconds dict (absent before
+    the run profiler existed). A record carrying a numeric
+    ``goodput_fraction`` field also contributes it to the
+    :data:`GOODPUT_METRIC` history."""
     history_dir = history_dir or REPO
     out = {}
     phases = {}
     comm = {}
+    states = {}
 
     def add(metric, value, source, rec=None):
         if not (metric and _numeric(value)):
@@ -118,6 +129,15 @@ def load_history(history_dir=None, with_phases=False):
             prev = comm.get((metric, source))
             if prev is None or _improves(float(value), prev[0], lower):
                 comm[(metric, source)] = (float(value), co)
+        st = (rec or {}).get("run_states")
+        if isinstance(st, dict):
+            prev = states.get((metric, source))
+            if prev is None or _improves(float(value), prev[0], lower):
+                states[(metric, source)] = (float(value), st)
+        gf = (rec or {}).get("goodput_fraction")
+        if metric != GOODPUT_METRIC and _numeric(gf):
+            add(GOODPUT_METRIC, gf, source,
+                {"run_states": (rec or {}).get("run_states")})
 
     # MULTICHIP_r*.json rounds carry the scaling-efficiency metric line
     # in their "tail" the same way BENCH rounds carry the TRAIN one
@@ -166,7 +186,8 @@ def load_history(history_dir=None, with_phases=False):
                              reverse=not lower)
     if with_phases:
         return (out, {k: ph for k, (_v, ph) in phases.items()},
-                {k: co for k, (_v, co) in comm.items()})
+                {k: co for k, (_v, co) in comm.items()},
+                {k: st for k, (_v, st) in states.items()})
     return out
 
 
@@ -268,6 +289,41 @@ def _comm_delta_line(records, metric, best_src, comm_hist, out):
     out.write(json.dumps(line) + "\n")
 
 
+def _states_delta_line(records, metric, best_src, state_hist, out):
+    """On a goodput regression, print the run-state anatomy next to the
+    failure: the run's state seconds, the best round's, and the biggest
+    badput movers — the run-level analog of :func:`_phase_delta_line`."""
+    run_states = None
+    for rec in records:
+        if isinstance(rec.get("run_states"), dict) and (
+                rec.get("metric") == metric or
+                _numeric(rec.get("goodput_fraction"))):
+            run_states = rec["run_states"]
+    best_states = state_hist.get((metric, best_src))
+    line = {"metric": "bench_gate_states", "gated": metric}
+    if run_states:
+        line["run"] = run_states
+    if best_states:
+        line["best"] = dict(best_states, _source=best_src)
+    if run_states and best_states:
+        deltas = {s: round(float(run_states.get(s, 0.0))
+                           - float(best_states.get(s, 0.0)), 4)
+                  for s in set(run_states) | set(best_states)
+                  if s != "_source"}
+        movers = sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:3]
+        line["delta"] = deltas
+        line["detail"] = "run-state shift vs %s: %s" % (
+            best_src, ", ".join("%s %+.3fs" % (s, d) for s, d in movers))
+    elif run_states:
+        line["detail"] = ("run carries state seconds but %s recorded "
+                          "none" % best_src)
+    else:
+        line["detail"] = ("no run-state attribution in this run — rerun "
+                          "bench.py (runprof) for a pre-diagnosed "
+                          "failure")
+    out.write(json.dumps(line) + "\n")
+
+
 def gate_records(records, history_dir=None, metric=None,
                  threshold=None, strict=False, out=None):
     """Gate already-parsed run records; returns the process exit code.
@@ -276,8 +332,8 @@ def gate_records(records, history_dir=None, metric=None,
     ``out`` defaults to the CURRENT sys.stdout (resolved per call, so
     redirected/captured stdout works)."""
     out = out if out is not None else sys.stdout
-    history, phase_hist, comm_hist = load_history(history_dir,
-                                                  with_phases=True)
+    history, phase_hist, comm_hist, state_hist = load_history(
+        history_dir, with_phases=True)
 
     def say(status, detail, **extra):
         line = dict({"metric": "bench_gate", "status": status,
@@ -288,6 +344,10 @@ def gate_records(records, history_dir=None, metric=None,
     for rec in records:
         if _numeric(rec.get("value")):
             by_metric[rec["metric"]] = float(rec["value"])  # last wins
+        if _numeric(rec.get("goodput_fraction")):
+            # run-anatomy field on the TRAIN record gates as its own
+            # metric (bench_all folds the attribution pass in)
+            by_metric[GOODPUT_METRIC] = float(rec["goodput_fraction"])
 
     if metric is None:
         # the TRAIN north-star when the run produced it, else the
@@ -338,6 +398,8 @@ def gate_records(records, history_dir=None, metric=None,
             value=value, best=best, floor=bound)
         if metric == MULTICHIP_METRIC:
             _comm_delta_line(records, metric, best_src, comm_hist, out)
+        elif metric == GOODPUT_METRIC:
+            _states_delta_line(records, metric, best_src, state_hist, out)
         return 0
 
     say("fail", "%s regressed: %.2f %s %s %.2f (best %.2f from %s, "
@@ -349,6 +411,10 @@ def gate_records(records, history_dir=None, metric=None,
         # a multichip regression is pre-diagnosed with the bytes/kind
         # movers (PR 6's bench_gate_phases pattern, comm edition)
         _comm_delta_line(records, metric, best_src, comm_hist, out)
+    elif metric == GOODPUT_METRIC:
+        # a goodput regression is pre-diagnosed with the run-state
+        # seconds movers (which badput state grew)
+        _states_delta_line(records, metric, best_src, state_hist, out)
     else:
         _phase_delta_line(records, metric, best_src, phase_hist, out)
     return 1

@@ -15,7 +15,10 @@ Runs the two gates that share exit-code conventions (0 = pass,
   latency through the same entry point, PLUS the multichip comm gate
   (``multichip_scaling_efficiency`` vs MULTICHIP_*.json history, a
   ``bench_gate_comm`` bytes-by-kind delta line on regression) whenever
-  the run carries MULTICHIP records.
+  the run carries MULTICHIP records, PLUS the run-anatomy goodput gate
+  (``train_goodput_fraction``, higher is better, a
+  ``bench_gate_states`` state-seconds delta line on regression)
+  whenever the run carries a ``goodput_fraction``.
 
 Usage:
     python tools/repo_gate.py                     # analysis only
@@ -75,6 +78,13 @@ def main(argv=None):
             # (higher is better, vs MULTICHIP_r*.json history)
             rc = max(rc, bench_gate.gate_records(
                 records, metric=bench_gate.MULTICHIP_METRIC, **kwargs))
+        if any(rec.get("metric") == bench_gate.GOODPUT_METRIC
+               or isinstance(rec.get("goodput_fraction"), (int, float))
+               for rec in records):
+            # a run carrying run-anatomy goodput also gates it (higher
+            # is better; a regression prints the state-seconds deltas)
+            rc = max(rc, bench_gate.gate_records(
+                records, metric=bench_gate.GOODPUT_METRIC, **kwargs))
 
     return rc
 
