@@ -394,6 +394,7 @@ class Executor:
 
         (outs, aux_up), vjp = jax.vjp(f, grad_args)
         cots = []
+        # mxanalyze: allow(dispatch-amplification): loops over OUTPUT HEADS (O(1) arity), not layers — each head needs its own dtype-dependent cotangent construction
         for o, hg in zip(outs, head_grads):
             if hg is not None:
                 cots.append(hg)

@@ -231,6 +231,7 @@ class Trainer:
                 self._kvstore.pull(i, param.data())
                 continue
             upd = self._updaters[0]
+            # mxanalyze: allow(dispatch-amplification): per-param fallback when the fused applier declines or kvstore owns the update; the fused path above is taken by default
             upd(i, param.grad(), param.data())
 
     def save_states(self, fname):

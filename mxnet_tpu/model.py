@@ -105,6 +105,7 @@ class FeedForward:
             mod.set_params(self.arg_params or {}, self.aux_params or {},
                            allow_missing=False)
         out = mod.predict(X, num_batch=num_batch, reset=reset)
+        # mxanalyze: allow(host-sync-hazard): FeedForward.predict's API contract returns numpy; the one readback sits at the end of the loop, not inside it
         return out.asnumpy() if hasattr(out, "asnumpy") else out
 
     def save(self, prefix, epoch=None):

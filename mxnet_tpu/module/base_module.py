@@ -148,7 +148,10 @@ class BaseModule:
             if isinstance(eval_data, np.ndarray):
                 from ..ndarray import array
                 eval_data = array(eval_data)
-            eval_data = io_mod.NDArrayIter(eval_data.asnumpy(),
+            # hand the NDArray straight to the iterator: its staging
+            # path owns the (single) host conversion, so predict()'s
+            # hot loop never forces a device->host sync itself
+            eval_data = io_mod.NDArrayIter(eval_data,
                                            batch_size=eval_data.shape[0])
         if reset:
             eval_data.reset()

@@ -659,6 +659,7 @@ class Module(BaseModule):
                                 [g for _, _, g in live])
                 else:
                     for i, name, grad in live:
+                        # mxanalyze: allow(dispatch-amplification): documented fallback when FusedApplier.resolve declines (non-fusable optimizer); the fused path above is the default
                         self._updater(i, grad, self._exec.arg_dict[name])
             if self._updater is not None:
                 self._note_optimizer_bytes(
@@ -691,6 +692,10 @@ class Module(BaseModule):
                           if n not in exec_._grad_names}
             weights = [exec_.arg_dict[n] for n in live_names]
             lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+            # ledger the optimizer bytes BEFORE the dispatch: state_vals
+            # is donated to the step (arg 7), so the old buffers must
+            # not be touched once the program runs
+            self._note_optimizer_bytes(state_vals)
             outs, aux_up, new_ws, new_states, grads = step_fn(
                 grad_args, other_args, aux_vals, key, lrs, wds, rescale,
                 state_vals)
@@ -707,7 +712,6 @@ class Module(BaseModule):
             stepprof.note_device_sample(
                 _dc.seconds, batches=1,
                 flops_per_batch=xla_stats.flops_per_batch())
-        self._note_optimizer_bytes(state_vals)
         for name, val in aux_up.items():
             exec_.aux_dict[name]._data = val
         for w, nv in zip(weights, new_ws):
@@ -758,6 +762,7 @@ class Module(BaseModule):
                 grad_args, other_args, aux_vals, key, heads)
             new_ws, new_states = [], []
             out_grads = {}
+            # mxanalyze: allow(dispatch-amplification): params have heterogeneous shapes/hyperparams so the per-param updates cannot stack into one lax.scan; the loop unrolls into ONE program (single dispatch), which is the point of the fused step
             for k, name in enumerate(live_names):
                 params = dict(static)
                 params["lr"] = lrs[k]
@@ -969,6 +974,8 @@ class Module(BaseModule):
                       if n not in exec_._grad_names and n not in placed}
             weights = [exec_.arg_dict[n] for n in live_names]
             lrs, wds, rescale, state_vals = fused.prepare(indices, weights)
+            # ledger BEFORE the dispatch — state_vals (arg 8) is donated
+            self._note_optimizer_bytes(state_vals)
             key = exec_._next_key()
             ga, aux, sv, outs = scan_fn(grad_args, consts, placed,
                                         aux_vals, key, lrs, wds, rescale,
@@ -985,7 +992,6 @@ class Module(BaseModule):
             stepprof.note_device_sample(
                 _dc.seconds, batches=K,
                 flops_per_batch=xla_stats.flops_per_batch())
-        self._note_optimizer_bytes(state_vals)
         for name, val in aux.items():
             exec_.aux_dict[name]._data = val
         # rebind EVERY carried arg (not just the updated weights): with
@@ -1013,10 +1019,14 @@ class Module(BaseModule):
         exec_ = self._exec
 
         def _stack(vals):
-            if all(isinstance(v, NDArray) for v in vals):
-                return jnp.stack([v._data for v in vals])
-            return _np.stack([v.asnumpy() if hasattr(v, "asnumpy")
-                              else _np.asarray(v) for v in vals])
+            if any(isinstance(v, NDArray) for v in vals):
+                # stack on device: host members UPLOAD (async h2d)
+                # instead of device members syncing back through
+                # asnumpy — the old mixed path drained the dispatch
+                # pipeline once per device-resident batch
+                return jnp.stack([v._data if isinstance(v, NDArray)
+                                  else jnp.asarray(v) for v in vals])
+            return _np.stack([_np.asarray(v) for v in vals])
 
         stacked = {}
         for i, name in enumerate(self._data_names):

@@ -813,6 +813,7 @@ class FusedApplier:
         if fn is None:
             def apply_all(lrs, wds, rescale, ws, gs, states):
                 new_ws, new_states = [], []
+                # mxanalyze: allow(dispatch-amplification): ws carries heterogeneous shapes (one group per shape is the caller's job); the unroll compiles into ONE fused apply program
                 for k in range(len(ws)):
                     params = dict(static)
                     params["lr"] = lrs[k]
@@ -828,9 +829,12 @@ class FusedApplier:
             # below, so XLA updates them in place (the reference's
             # kWriteInplace optimizer kernels). Weights are NOT donated —
             # user code may hold views of the old weight buffers, which
-            # donation would invalidate. CPU backends don't implement
-            # donation (JAX warns per compile), so gate on the device.
-            donate = (5,) if donate_key else ()
+            # donation would invalidate. donate_argnums_for is the
+            # repo-wide donation policy point: it strips the set on CPU
+            # backends (which don't implement donation).
+            from .compiled import donate_argnums_for
+            donate = donate_argnums_for(
+                weights[0].context, (5,)) if donate_key else ()
             fn = jax.jit(apply_all, donate_argnums=donate)
             self._jit_cache[key] = fn
 

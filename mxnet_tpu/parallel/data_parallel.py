@@ -47,9 +47,12 @@ def _jit_step(loss_fn, optimizer_update, donate_params, policy=None):
         new_params, new_opt_state = optimizer_update(params, grads, opt_state)
         return loss, new_params, new_opt_state
 
-    from ..compiled import tracked_jit
+    from ..compiled import donate_argnums_for, tracked_jit
+    # route through the donation policy point: the set is stripped on
+    # CPU backends, and repo-wide donation knobs keep applying
+    donate = donate_argnums_for(None, (0, 1)) if donate_params else ()
     return tracked_jit(step, "data_parallel.step",
-                       donate_argnums=(0, 1) if donate_params else (),
+                       donate_argnums=donate,
                        policy=policy)
 
 

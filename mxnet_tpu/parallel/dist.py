@@ -233,9 +233,12 @@ def allreduce_nds(nds):
     key = tuple((tuple(nd.shape), str(nd.dtype)) for nd in nds)
     fn = _AR_JIT.get(key)
     if fn is None:
+        from ..compiled import donate_argnums_for
+        # the gathered inputs are consumed by the reduction: donate them
+        # where the backend supports it (policy point strips CPU)
+        donate = donate_argnums_for(None, tuple(range(len(nds))))
         fn = jax.jit(lambda *gs: tuple(jnp.sum(g, axis=0) for g in gs),
-                     out_shardings=out_shard, donate_argnums=tuple(
-                         range(len(nds))))
+                     out_shardings=out_shard, donate_argnums=donate)
         _AR_JIT[key] = fn
     outs = fn(*globals_in)
 

@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._compat import shard_map
 
+# mxanalyze: allow(sharding-reachability): known integration debt (ROADMAP item 2) — the MoE front door is not yet wired into Module/gluon; tracked until a frontend path lands
 __all__ = ["moe_apply", "stack_expert_params", "MoETrainStep"]
 
 

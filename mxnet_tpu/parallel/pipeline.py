@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ._compat import shard_map
 
+# mxanalyze: allow(sharding-reachability): known integration debt (ROADMAP item 2) — pipeline parallelism has no Module/gluon front door yet; tracked until a frontend path lands
 __all__ = ["pipeline_apply", "stack_stage_params", "PipelineTrainStep"]
 
 

@@ -440,6 +440,488 @@ class TestEnvVarDrift:
         assert not [f for f in fs if f.rule == "env-var-drift"]
 
 
+class TestHostSyncHazard:
+    def test_asnumpy_in_hot_function_flags(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def predict(self, eval_data):
+                for batch in eval_data:
+                    out = self.forward(batch)
+                    yield out.asnumpy()
+            """, relpath="mxnet_tpu/module/mod.py")
+        hits = [f for f in fs if f.rule == "host-sync-hazard"]
+        assert len(hits) == 1 and ".asnumpy()" in hits[0].message
+
+    def test_taint_flow_device_vs_host_values(self, tmp_path):
+        """float() flags only when taint says the operand came off the
+        device — and only for values tainted BEFORE the sink runs."""
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def impl(x):
+                return x
+
+            fwd = jax.jit(impl)
+
+            def _step(self, batch, cfg):
+                loss = fwd(batch)
+                lr = float(cfg["lr"])     # host value: clean
+                bad = float(loss)         # device value: flags
+                loss = cfg["lr"]
+                ok = float(loss)          # rebound to host value: clean
+                return bad, lr, ok
+            """, relpath="mxnet_tpu/module/mod.py")
+        hits = [f for f in fs if f.rule == "host-sync-hazard"]
+        assert len(hits) == 1, fs
+        assert "float()" in hits[0].message
+
+    def test_branch_on_device_value_flags(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def impl(x):
+                return x
+
+            fwd = jax.jit(impl)
+
+            def _step(self, batch):
+                loss = fwd(batch)
+                if loss > 10.0:
+                    raise RuntimeError("diverged")
+            """, relpath="mxnet_tpu/module/mod.py")
+        hits = [f for f in fs if f.rule == "host-sync-hazard"]
+        assert len(hits) == 1 and "branch" in hits[0].message
+
+    def test_block_until_ready_needs_sync_sampling(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+            from mxnet_tpu import stepprof
+
+            def _step(self, out, out2):
+                jax.block_until_ready(out)          # unsampled: flags
+                if stepprof.should_sync():
+                    jax.block_until_ready(out2)     # sampled: clean
+            """, relpath="mxnet_tpu/module/mod.py")
+        hits = [f for f in fs if f.rule == "host-sync-hazard"]
+        assert len(hits) == 1, fs
+        assert "block_until_ready" in hits[0].message
+
+    def test_cold_functions_and_cold_modules_out_of_scope(self, tmp_path):
+        src = """
+            def helper(x):
+                return x.asnumpy()
+            """
+        # a non-hot function in a hot module: out of scope
+        fs = _analyze(tmp_path, src, relpath="mxnet_tpu/module/mod.py")
+        assert not [f for f in fs if f.rule == "host-sync-hazard"]
+        # a hot-named function in a cold module: out of scope
+        fs = _analyze(tmp_path, """
+            def update(self, labels, preds):
+                return preds.asnumpy()
+            """, relpath="mxnet_tpu/metric.py")
+        assert not [f for f in fs if f.rule == "host-sync-hazard"]
+
+    def test_suppression(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def predict(self, out):
+                # mxanalyze: allow(host-sync-hazard): API returns numpy
+                return out.asnumpy()
+            """, relpath="mxnet_tpu/module/mod.py")
+        assert not [f for f in fs if f.rule == "host-sync-hazard"], fs
+
+    def test_flips_gate_against_empty_baseline(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def _step(self, out):
+                return out.asnumpy()
+            """, relpath="mxnet_tpu/module/mod.py")
+        new, _, _ = diff_baseline(fs, {})
+        assert [f for f in new if f.rule == "host-sync-hazard"]
+
+
+class TestDispatchAmplification:
+    def test_param_loop_inside_traced_fn(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def step(grad_args, live_names):
+                outs = []
+                for k, name in enumerate(live_names):
+                    outs.append(apply_one(grad_args[name]))
+                return outs
+
+            fn = jax.jit(step)
+            """, relpath="mxnet_tpu/module/mod.py")
+        hits = [f for f in fs if f.rule == "dispatch-amplification"]
+        assert len(hits) == 1 and "unrolls" in hits[0].message
+
+    def test_host_per_param_updater_loop(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def update(self):
+                for i, param in enumerate(self._params):
+                    self._updater(i, param.grad, param.data)
+            """, relpath="mxnet_tpu/gluon/mytrainer.py")
+        hits = [f for f in fs if f.rule == "dispatch-amplification"]
+        assert len(hits) == 1 and "per-param" in hits[0].message
+
+    def test_non_param_loops_clean(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def step(xs, rows):
+                total = 0
+                for r in rows:          # not a param collection
+                    total = total + r
+                return total
+
+            fn = jax.jit(step)
+
+            def host_loop(batches):
+                for b in batches:       # no updater call
+                    consume(b)
+            """, relpath="mxnet_tpu/module/mod.py")
+        assert not [f for f in fs
+                    if f.rule == "dispatch-amplification"], fs
+
+    def test_suppression_and_baseline_roundtrip(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            def update(self):
+                for i, param in enumerate(self._params):
+                    # mxanalyze: allow(dispatch-amplification): fallback path
+                    self._updater(i, param.grad, param.data)
+            """, relpath="mxnet_tpu/gluon/mytrainer.py")
+        assert not [f for f in fs
+                    if f.rule == "dispatch-amplification"], fs
+        # unsuppressed finding round-trips through the baseline
+        fs = _analyze(tmp_path, """
+            def update(self):
+                for i, param in enumerate(self._params):
+                    self._updater(i, param.grad, param.data)
+            """, relpath="mxnet_tpu/gluon/mytrainer.py")
+        bl_path = tmp_path / "bl.json"
+        save_baseline(str(bl_path), fs)
+        new, baselined, stale = diff_baseline(
+            fs, load_baseline(str(bl_path)))
+        assert not new and not stale and baselined
+
+
+class TestDonationHazard:
+    def test_unrouted_donation_flags(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def step(params, grads):
+                return params
+
+            fn = jax.jit(step, donate_argnums=(0,))
+            """, relpath="mxnet_tpu/mymod.py")
+        hits = [f for f in fs if f.rule == "donation-hazard"]
+        assert len(hits) == 1
+        assert "donate_argnums_for" in hits[0].message
+
+    def test_routed_and_empty_are_clean(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+            from mxnet_tpu.compiled import donate_argnums_for
+
+            def step(params, grads):
+                return params
+
+            def build(ctx, donate_params):
+                donate = donate_argnums_for(ctx, (0,)) \\
+                    if donate_params else ()
+                a = jax.jit(step, donate_argnums=donate)
+                b = jax.jit(step, donate_argnums=())
+                c = jax.jit(step,
+                            donate_argnums=donate_argnums_for(ctx, (0,)))
+                return a, b, c
+            """, relpath="mxnet_tpu/mymod.py")
+        assert not [f for f in fs if f.rule == "donation-hazard"], fs
+
+    def test_use_after_donation_flags(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+            from mxnet_tpu.compiled import donate_argnums_for
+
+            def step(params, state):
+                return params, state
+
+            fn = jax.jit(step,
+                         donate_argnums=donate_argnums_for(None, (1,)))
+
+            def train(params, state):
+                new_p, new_s = fn(params, state)
+                note_bytes(state)        # old donated buffer: flags
+                return new_p, new_s
+            """, relpath="mxnet_tpu/mymod.py")
+        hits = [f for f in fs if f.rule == "donation-hazard"]
+        assert len(hits) == 1, fs
+        assert "use after donation" in hits[0].message
+        assert "'state'" in hits[0].message
+
+    def test_read_before_call_and_rebinding_clean(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+            from mxnet_tpu.compiled import donate_argnums_for
+
+            def step(params, state):
+                return params, state
+
+            fn = jax.jit(step,
+                         donate_argnums=donate_argnums_for(None, (1,)))
+
+            def train(params, state):
+                note_bytes(state)        # BEFORE the dispatch: clean
+                new_p, state = fn(params, state)
+                return new_p, state      # rebound to the output: clean
+            """, relpath="mxnet_tpu/mymod.py")
+        assert not [f for f in fs if f.rule == "donation-hazard"], fs
+
+    def test_severity_is_error(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def step(params):
+                return params
+
+            fn = jax.jit(step, donate_argnums=(0,))
+            """, relpath="mxnet_tpu/mymod.py")
+        hits = [f for f in fs if f.rule == "donation-hazard"]
+        assert hits and all(f.severity == "error" for f in hits)
+
+
+class TestShardingReachability:
+    def test_dead_spec_flags_applied_spec_clean(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def place(mesh, x, y):
+                spec = P("data")            # never applied: flags
+                used = P("data", "model")
+                return NamedSharding(mesh, used)
+            """, relpath="mxnet_tpu/mymod.py")
+        hits = [f for f in fs if f.rule == "sharding-reachability"]
+        assert len(hits) == 1, fs
+        assert "'spec'" in hits[0].message
+
+    def test_dead_spec_suppression(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            from jax.sharding import PartitionSpec as P
+
+            def place(mesh):
+                # mxanalyze: allow(sharding-reachability): doc example
+                spec = P("data")
+            """, relpath="mxnet_tpu/mymod.py")
+        assert not [f for f in fs
+                    if f.rule == "sharding-reachability"], fs
+
+    def _project(self, tmp_path, frontend_src):
+        (tmp_path / "mxnet_tpu" / "parallel").mkdir(parents=True)
+        (tmp_path / "mxnet_tpu" / "parallel" / "zoo.py").write_text(
+            textwrap.dedent("""
+                __all__ = ["zoo_apply"]
+
+                def zoo_apply(x):
+                    return x
+                """))
+        (tmp_path / "mxnet_tpu" / "parallel" / "__init__.py").write_text(
+            "from .zoo import zoo_apply\n")
+        (tmp_path / "mxnet_tpu" / "frontend.py").write_text(
+            textwrap.dedent(frontend_src))
+        env_doc = tmp_path / "env_var.md"
+        env_doc.write_text("")
+        return analyze_paths([str(tmp_path / "mxnet_tpu")],
+                             root=str(tmp_path), env_doc=str(env_doc))
+
+    def test_dead_public_surface_flags(self, tmp_path):
+        fs = self._project(tmp_path, """
+            def fit(x):
+                return x
+            """)
+        hits = [f for f in fs if f.rule == "sharding-reachability"]
+        assert len(hits) == 1, fs
+        assert "unreachable" in hits[0].message
+        assert hits[0].path == "mxnet_tpu/parallel/zoo.py"
+
+    def test_reached_surface_clean(self, tmp_path):
+        fs = self._project(tmp_path, """
+            from .parallel import zoo_apply
+
+            def fit(x):
+                return zoo_apply(x)
+            """)
+        assert not [f for f in fs
+                    if f.rule == "sharding-reachability"], fs
+
+    def test_no_frontend_in_scope_no_dead_surface(self, tmp_path):
+        """A --changed-only-style run over just the parallel module must
+        not call everything dead for lack of visible callers."""
+        (tmp_path / "mxnet_tpu" / "parallel").mkdir(parents=True)
+        p = tmp_path / "mxnet_tpu" / "parallel" / "zoo.py"
+        p.write_text("__all__ = [\"zoo_apply\"]\n\n"
+                     "def zoo_apply(x):\n    return x\n")
+        env_doc = tmp_path / "env_var.md"
+        env_doc.write_text("")
+        fs = analyze_paths([str(p)], root=str(tmp_path),
+                           env_doc=str(env_doc))
+        assert not [f for f in fs
+                    if f.rule == "sharding-reachability"], fs
+
+
+# ---------------------------------------------------------------------------
+# --profile: runtime verdicts escalate matching findings
+# ---------------------------------------------------------------------------
+
+class TestProfileVerdicts:
+    def _snapshot_dir(self, tmp_path, stepprof=None, shardprof=None,
+                      runprof=None):
+        d = tmp_path / "telemetry"
+        d.mkdir(exist_ok=True)
+        if stepprof is not None:
+            (d / "stepprof_host0_pid1.json").write_text(
+                json.dumps(stepprof))
+        if shardprof is not None:
+            (d / "shardprof_host0_pid1.json").write_text(
+                json.dumps(shardprof))
+        if runprof is not None:
+            (d / "runprof_i0_host0_pid1.json").write_text(
+                json.dumps(runprof))
+        return str(d)
+
+    def test_read_verdicts_from_synthetic_snapshots(self, tmp_path):
+        from tools.mxanalyze import profiles
+        d = self._snapshot_dir(
+            tmp_path,
+            stepprof={"verdict": "dispatch-bound", "hint": "fuse"},
+            shardprof={"audit": {"flagged": 3},
+                       "comm": {"overlap_fraction": 0.1}},
+            runprof={"states": {"train_productive": 5.0,
+                                "compile": 20.0},
+                     "goodput_fraction": 0.2})
+        names = {v["verdict"] for v in profiles.read_verdicts(d)}
+        assert names == {"dispatch-bound", "replicated-params",
+                         "unoverlapped-comm", "compile-heavy"}
+
+    def test_dispatch_verdict_escalates_step_path_finding(self, tmp_path):
+        from tools.mxanalyze import profiles
+        fs = _analyze(tmp_path, """
+            import jax
+
+            def step(grad_args, live_names):
+                outs = []
+                for k, name in enumerate(live_names):
+                    outs.append(apply_one(grad_args[name]))
+                return outs
+
+            fn = jax.jit(step)
+            """, relpath="mxnet_tpu/module/mod.py")
+        d = self._snapshot_dir(
+            tmp_path, stepprof={"verdict": "dispatch-bound"})
+        verdicts = profiles.read_verdicts(d)
+        escalated = profiles.escalate(fs, verdicts)
+        hits = [f for f in escalated
+                if f.rule == "dispatch-amplification"]
+        assert hits, fs
+        assert all(f.severity == "error" for f in hits)
+        assert all(f.escalated == "dispatch-bound" for f in hits)
+        assert all(f.to_dict()["escalated_by"] == "dispatch-bound"
+                   for f in hits)
+
+    def test_unrelated_verdict_escalates_nothing(self, tmp_path):
+        from tools.mxanalyze import profiles
+        fs = _analyze(tmp_path, """
+            def predict(self, out):
+                return out.asnumpy()
+            """, relpath="mxnet_tpu/module/mod.py")
+        d = self._snapshot_dir(
+            tmp_path, stepprof={"verdict": "dispatch-bound"})
+        assert profiles.escalate(fs, profiles.read_verdicts(d)) == []
+        # ...but a sync-bound verdict matches the host-sync finding
+        d2 = self._snapshot_dir(
+            tmp_path, stepprof={"verdict": "sync-bound"})
+        esc = profiles.escalate(fs, profiles.read_verdicts(d2))
+        assert len(esc) == 1 and esc[0].rule == "host-sync-hazard"
+
+    def test_healthy_runprof_yields_no_verdict(self, tmp_path):
+        from tools.mxanalyze import profiles
+        d = self._snapshot_dir(
+            tmp_path,
+            runprof={"states": {"train_productive": 95.0,
+                                "compile": 2.0},
+                     "goodput_fraction": 0.97})
+        assert profiles.read_verdicts(d) == []
+
+    def test_cli_profile_emits_perf_gate_line(self, tmp_path):
+        d = self._snapshot_dir(
+            tmp_path, stepprof={"verdict": "dispatch-bound"})
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = _run_cli([str(clean), "--profile", d, "--env-doc",
+                      str(doc), "--baseline",
+                      str(tmp_path / "bl.json")])
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = r.stdout.strip().splitlines()
+        perf = json.loads(lines[-1])
+        assert perf["metric"] == "mxanalyze_perf_gate"
+        assert perf["status"] == "pass"
+        assert perf["verdicts"] == ["dispatch-bound"]
+        gate = json.loads(lines[-2])
+        assert gate["metric"] == "mxanalyze_gate"
+
+    def test_cli_profile_empty_dir(self, tmp_path):
+        d = tmp_path / "empty"
+        d.mkdir()
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = _run_cli([str(clean), "--profile", str(d), "--env-doc",
+                      str(doc), "--baseline",
+                      str(tmp_path / "bl.json")])
+        assert r.returncode == 0, r.stdout + r.stderr
+        perf = json.loads(r.stdout.strip().splitlines()[-1])
+        assert perf["metric"] == "mxanalyze_perf_gate"
+        assert "no profiler verdicts" in perf["detail"]
+
+
+# ---------------------------------------------------------------------------
+# --changed-only: git-scoped incremental runs
+# ---------------------------------------------------------------------------
+
+class TestChangedOnly:
+    def _git(self, cwd, *args):
+        return subprocess.run(["git", "-C", str(cwd)] + list(args),
+                              capture_output=True, text=True, check=True)
+
+    def test_changed_files_lists_modified_and_untracked(self, tmp_path):
+        from tools.mxanalyze.cli import changed_files
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "commit", "--allow-empty", "-qm", "seed")
+        (tmp_path / "pkg").mkdir()
+        tracked = tmp_path / "pkg" / "a.py"
+        tracked.write_text("X = 1\n")
+        self._git(tmp_path, "add", "pkg/a.py")
+        self._git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+                  "commit", "-qm", "add a")
+        assert changed_files(str(tmp_path), ["pkg/"]) == []
+        tracked.write_text("X = 2\n")                    # modified
+        (tmp_path / "pkg" / "b.py").write_text("Y = 1\n")  # untracked
+        (tmp_path / "pkg" / "c.txt").write_text("not py\n")
+        (tmp_path / "other.py").write_text("Z = 1\n")    # out of scope
+        assert changed_files(str(tmp_path), ["pkg/"]) == [
+            "pkg/a.py", "pkg/b.py"]
+
+    def test_cli_changed_only_smoke(self):
+        """Same exit-code conventions on the real repo: the changed set
+        (possibly empty) analyzes clean against the baseline."""
+        r = _run_cli(["--changed-only", "--strict"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["metric"] == "mxanalyze_gate"
+        assert gate["status"] == "pass"
+
+
 # ---------------------------------------------------------------------------
 # baseline round-trip
 # ---------------------------------------------------------------------------
@@ -684,5 +1166,48 @@ class TestRepoGate:
     def test_known_rules_registry(self):
         from tools.mxanalyze import RULES
         for rule in ("jit-purity", "retrace-hazard", "lock-discipline",
-                     "swallowed-exception", "env-var-drift"):
+                     "swallowed-exception", "env-var-drift",
+                     "host-sync-hazard", "dispatch-amplification",
+                     "donation-hazard", "sharding-reachability"):
             assert rule in RULES
+
+    def test_all_passes_cover_all_rules(self):
+        # every pass rule is registered; RULES additionally carries the
+        # framework's synthetic rules (parse-error, bad-suppression)
+        from tools.mxanalyze import RULES
+        from tools.mxanalyze.passes import ALL_PASSES
+        pass_rules = {p.rule for p in ALL_PASSES}
+        assert pass_rules <= set(RULES)
+        assert {"host-sync-hazard", "dispatch-amplification",
+                "donation-hazard",
+                "sharding-reachability"} <= pass_rules
+
+    def test_bench_with_adjacent_snapshots_runs_perf_gate(self, tmp_path):
+        """repo_gate --bench auto-runs mxanalyze --profile when
+        telemetry snapshots sit next to the bench records."""
+        bench = tmp_path / "run.jsonl"
+        bench.write_text("")   # no records: bench gate skips, exit 0
+        (tmp_path / "stepprof_host0_pid1.json").write_text(
+            json.dumps({"verdict": "compute-bound", "hint": ""}))
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "repo_gate.py"),
+             "--bench", str(bench)],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stdout + r.stderr
+        perf = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.startswith("{") and "mxanalyze_perf_gate" in ln]
+        assert len(perf) == 1, r.stdout
+        assert perf[0]["status"] == "pass"
+        assert perf[0]["verdicts"] == ["compute-bound"]
+
+    def test_bench_without_snapshots_skips_perf_gate(self, tmp_path):
+        bench = tmp_path / "run.jsonl"
+        bench.write_text("")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "repo_gate.py"),
+             "--bench", str(bench)],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "mxanalyze_perf_gate" not in r.stdout

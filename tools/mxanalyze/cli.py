@@ -1,15 +1,23 @@
 """CLI: ``python -m tools.mxanalyze [--strict] [--update-baseline]
-[paths...]``.
+[--changed-only] [--profile DIR] [paths...]``.
 
 Exit codes follow ``tools/bench_gate.py``: 0 = gate passes, 1 = gate
 fails, 2 = usage error; the last stdout line is a BENCH-style JSON
 record (``{"metric": "mxanalyze_gate", "status": ...}``) so the same
 log-scraping that gates perf regressions gates analysis regressions.
+
+``--changed-only`` scopes the run to the files git says changed
+(worktree vs HEAD, plus untracked) — same rules, same exit codes, a
+fast incremental gate. ``--profile <telemetry-dir>`` additionally joins
+the findings with stepprof/shardprof/runprof runtime verdicts: findings
+a verdict explains are escalated to error (baseline amnesty does not
+apply) and a second ``mxanalyze_perf_gate`` line is emitted.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .baseline import (default_baseline_path, diff_baseline,
@@ -20,15 +28,48 @@ from .core import (RULES, analyze_paths, repo_root,
 DEFAULT_PATHS = ["mxnet_tpu"]
 
 
-def gate_line(status, detail, out=None, **extra):
+def gate_line(status, detail, out=None, metric="mxanalyze_gate",
+              **extra):
     # out resolves to the CURRENT sys.stdout per call (same lesson as
     # bench_gate.gate_records): a module-level default would bind
     # whatever capture stream was live at first import and break every
     # later redirected caller
     out = out if out is not None else sys.stdout
-    rec = dict({"metric": "mxanalyze_gate", "status": status,
+    rec = dict({"metric": metric, "status": status,
                 "detail": detail}, **extra)
     out.write(json.dumps(rec) + "\n")
+
+
+def changed_files(root, scope):
+    """Repo-relative .py files git reports changed (worktree vs HEAD,
+    plus untracked), filtered to the requested ``scope`` prefixes.
+    Raises OSError when git itself fails — the gate must not silently
+    pass because the diff could not be computed."""
+    import subprocess
+    names = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.SubprocessError) as exc:
+            raise OSError("git diff failed: %s" % exc)
+        if proc.returncode != 0:
+            raise OSError("git diff failed: %s"
+                          % proc.stderr.strip().splitlines()[-1:]
+                          or proc.returncode)
+        names.update(ln.strip() for ln in proc.stdout.splitlines()
+                     if ln.strip())
+    out = []
+    for rel in sorted(names):
+        if not rel.endswith(".py"):
+            continue
+        if not any(rel == p or rel.startswith(p) for p in scope):
+            continue
+        if os.path.exists(os.path.join(root, rel)):   # deletions drop
+            out.append(rel)
+    return out
 
 
 def main(argv=None):
@@ -40,6 +81,14 @@ def main(argv=None):
                     help="files/dirs to analyze (default: mxnet_tpu/)")
     ap.add_argument("--strict", action="store_true",
                     help="also fail on stale baseline entries")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="analyze only files git reports changed "
+                         "(within the given paths); same exit codes")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="telemetry dir of stepprof/shardprof/runprof "
+                         "host snapshots: escalate findings matching "
+                         "runtime verdicts and emit an "
+                         "mxanalyze_perf_gate line")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from this run and exit 0")
     ap.add_argument("--baseline", default=None,
@@ -56,6 +105,16 @@ def main(argv=None):
 
     root = repo_root()
     paths = args.paths or DEFAULT_PATHS
+    if args.changed_only:
+        try:
+            paths = changed_files(root, scope_prefixes(paths, root))
+        except OSError as exc:
+            print("mxanalyze: %s" % exc, file=sys.stderr)
+            return 2
+        if not paths:
+            gate_line("pass", "changed-only: no changed files in scope",
+                      new=0, baselined=0, stale=0)
+            return 0
     try:
         findings = analyze_paths(paths, root=root, env_doc=args.env_doc)
     except OSError as exc:
@@ -95,15 +154,33 @@ def main(argv=None):
     new, baselined, stale = diff_baseline(findings, baseline)
     stale = {fp: n for fp, n in stale.items() if in_scope(fp)}
 
-    shown = findings if args.all else new
+    # --profile: escalation runs over ALL findings (baselined included)
+    # BEFORE printing, so escalated findings render with their tag and
+    # surface even when the baseline would have hidden them
+    verdicts, escalated = [], []
+    if args.profile is not None:
+        from . import profiles
+        verdicts = profiles.read_verdicts(args.profile)
+        escalated = profiles.escalate(findings, verdicts)
+
+    shown = findings if args.all else sorted(
+        set(new) | set(escalated), key=lambda f: f.sort_key())
     if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_dict() for f in shown],
-            "new": len(new), "baselined": len(baselined),
-            "stale": sum(stale.values())}, indent=1))
+        doc = {"findings": [f.to_dict() for f in shown],
+               "new": len(new), "baselined": len(baselined),
+               "stale": sum(stale.values())}
+        if args.profile is not None:
+            doc["verdicts"] = verdicts
+            doc["escalated"] = len(escalated)
+        print(json.dumps(doc, indent=1))
     else:
+        for v in verdicts:
+            print("runtime verdict [%s, %s]: %s%s"
+                  % (v["verdict"], v["source"], v["file"],
+                     " -- " + v["detail"] if v["detail"] else ""))
+        new_set = set(new)
         for f in shown:
-            tag = "" if f in new else " [baselined]"
+            tag = "" if f in new_set else " [baselined]"
             print(f.render() + tag)
         for fp, n in sorted(stale.items()):
             print("stale baseline entry (finding fixed -- run "
@@ -117,4 +194,22 @@ def main(argv=None):
               "clean: %d finding(s), all baselined" % len(baselined))
     gate_line("fail" if failed else "pass", detail, new=len(new),
               baselined=len(baselined), stale=sum(stale.values()))
+
+    if args.profile is not None:
+        perf_failed = bool(escalated)
+        if not verdicts:
+            perf_detail = "no profiler verdicts under %s" % args.profile
+        elif escalated:
+            perf_detail = ("%d finding(s) escalated by runtime "
+                           "verdict(s) %s"
+                           % (len(escalated), ", ".join(
+                               sorted({f.escalated for f in escalated}))))
+        else:
+            perf_detail = ("%d verdict(s), no matching findings"
+                           % len(verdicts))
+        gate_line("fail" if perf_failed else "pass", perf_detail,
+                  metric="mxanalyze_perf_gate",
+                  verdicts=[v["verdict"] for v in verdicts],
+                  escalated=len(escalated))
+        failed = failed or perf_failed
     return 1 if failed else 0

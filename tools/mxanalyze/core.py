@@ -26,6 +26,18 @@ RULES = {
                            "log, nor bump a telemetry counter",
     "env-var-drift": "MXNET_* env var read in code but undocumented in "
                      "docs/env_var.md",
+    "host-sync-hazard": "device->host synchronization inside step/fit/"
+                        "serving hot loops (asnumpy/item/float/branching "
+                        "on device values, unsampled block_until_ready)",
+    "dispatch-amplification": "per-layer/per-param Python loops that "
+                              "multiply dispatches (scan-over-layers and "
+                              "fused-optimizer candidates)",
+    "donation-hazard": "jit/CompiledProgram sites replacing param/"
+                       "optimizer buffers without donate_argnums_for, "
+                       "or reading a buffer after donating it",
+    "sharding-reachability": "sharding specs with no in-program "
+                             "constraint path, and parallel modules "
+                             "unreachable from any frontend",
     "bad-suppression": "malformed mxanalyze suppression comment",
     "parse-error": "file could not be parsed",
 }
@@ -36,6 +48,10 @@ SEVERITY = {
     "lock-discipline": "warning",
     "swallowed-exception": "warning",
     "env-var-drift": "error",
+    "host-sync-hazard": "warning",
+    "dispatch-amplification": "warning",
+    "donation-hazard": "error",
+    "sharding-reachability": "warning",
     "bad-suppression": "warning",
     "parse-error": "error",
 }
@@ -55,7 +71,8 @@ class Finding:
     finding do not churn ``baseline.json``.
     """
 
-    __slots__ = ("rule", "path", "line", "col", "message", "hint")
+    __slots__ = ("rule", "path", "line", "col", "message", "hint",
+                 "escalated")
 
     def __init__(self, rule, path, line, col, message, hint=""):
         self.rule = rule
@@ -64,9 +81,14 @@ class Finding:
         self.col = int(col)
         self.message = message
         self.hint = hint
+        #: runtime-verdict name when --profile promoted this finding
+        #: to error (e.g. "dispatch-bound"), else None
+        self.escalated = None
 
     @property
     def severity(self):
+        if self.escalated:
+            return "error"
         return SEVERITY.get(self.rule, "warning")
 
     def fingerprint(self):
@@ -76,14 +98,19 @@ class Finding:
         return (self.path, self.line, self.col, self.rule, self.message)
 
     def to_dict(self):
-        return {"rule": self.rule, "severity": self.severity,
-                "path": self.path, "line": self.line, "col": self.col,
-                "message": self.message, "hint": self.hint}
+        d = {"rule": self.rule, "severity": self.severity,
+             "path": self.path, "line": self.line, "col": self.col,
+             "message": self.message, "hint": self.hint}
+        if self.escalated:
+            d["escalated_by"] = self.escalated
+        return d
 
     def render(self):
         out = "%s:%d:%d: [%s] %s: %s" % (
             self.path, self.line, self.col, self.rule, self.severity,
             self.message)
+        if self.escalated:
+            out += " [escalated by runtime verdict: %s]" % self.escalated
         if self.hint:
             out += " (hint: %s)" % self.hint
         return out

@@ -1,8 +1,10 @@
 """Pass registry: one module per rule, each exporting ``PASS``."""
-from . import envvars, jit_purity, locks, retrace, swallowed
+from . import (dispatch, donation, envvars, hostsync, jit_purity, locks,
+               retrace, sharding, swallowed)
 
 #: run order is reporting order for ties; findings are re-sorted anyway.
 ALL_PASSES = [jit_purity.PASS, retrace.PASS, locks.PASS, swallowed.PASS,
-              envvars.PASS]
+              envvars.PASS, hostsync.PASS, dispatch.PASS, donation.PASS,
+              sharding.PASS]
 
 __all__ = ["ALL_PASSES"]

@@ -1,0 +1,153 @@
+"""dispatch-amplification: per-layer/per-param Python loops that
+multiply dispatches.
+
+ROADMAP item 1's dispatch-bound verdict has two canonical source
+shapes, and this pass names both:
+
+1. a Python ``for`` loop over layers/params LEXICALLY INSIDE a jitted
+   (or CompiledProgram-dispatched) step function whose body makes
+   calls — each iteration is unrolled into the HLO, so compile time
+   and program size scale with depth where ``lax.scan`` would keep
+   them constant.
+2. a per-param optimizer update OUTSIDE the compiled step: a host-side
+   ``for`` over params whose body calls an updater — N param tensors
+   become N dispatches per step where a fused (stacked) applier or an
+   in-step optimizer would be one.
+
+Both shapes are sometimes deliberate (heterogeneous shapes cannot
+scan; the per-param path is the documented fallback when fusion is
+off) — those sites carry
+``# mxanalyze: allow(dispatch-amplification): <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding
+from .common import dotted_parts, jit_index
+
+RULE = "dispatch-amplification"
+
+#: iterable names that look like a parameter/layer collection
+_PARAMISH_RE = re.compile(
+    r"param|weight|layer|grad|live|expert|stage|block|cell")
+_PARAMISH_EXACT = {"ws", "gs", "sv", "weights", "grads", "states",
+                   "params"}
+
+#: callee tails that apply one param's update (host-side loop check)
+_UPDATER_RE = re.compile(r"^_?updaters?\d*$|^upd$|^update_multi_precision$")
+
+
+def _iter_names(node):
+    """Name identifiers mentioned anywhere in a loop's iterable."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+def _paramish(names):
+    return any(n in _PARAMISH_EXACT or _PARAMISH_RE.search(n)
+               for n in names)
+
+
+def _has_call(body):
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                return True
+    return False
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if not mod.relpath.startswith("mxnet_tpu/"):
+                continue
+            index = jit_index(mod)
+            jitted_ids = set()
+            for d in index.jitted_defs:
+                for sub in ast.walk(d):
+                    jitted_ids.add(id(sub))
+            findings.extend(self._check_traced_loops(mod, index))
+            findings.extend(self._check_host_updates(mod, jitted_ids))
+        return findings
+
+    # (1) unrolled for-loops inside traced bodies
+    def _check_traced_loops(self, mod, index):
+        out = []
+        seen = set()
+        for d in index.jitted_defs:
+            for node in ast.walk(d):
+                if not isinstance(node, ast.For) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                names = _iter_names(node.iter)
+                if not _paramish(names) or not _has_call(node.body):
+                    continue
+                out.append(Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    "Python for over a param/layer collection inside a "
+                    "traced function: the loop unrolls into the HLO, "
+                    "so program size and compile time scale with depth",
+                    hint="restructure as lax.scan over stacked leaves, "
+                         "or annotate why unrolling is required "
+                         "(`# mxanalyze: allow("
+                         "dispatch-amplification): <reason>`)"))
+        return out
+
+    # (2) host-side per-param updater loops
+    def _check_host_updates(self, mod, jitted_ids):
+        out = []
+        seen = set()
+        for fn in _functions(mod.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.For) \
+                        or id(node) in jitted_ids \
+                        or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if not _paramish(_iter_names(node.iter)):
+                    continue
+                upd = self._updater_call(node.body)
+                if upd is None:
+                    continue
+                out.append(Finding(
+                    RULE, mod.relpath, upd.lineno, upd.col_offset,
+                    "per-param optimizer update in a host loop: one "
+                    "dispatch per parameter per step instead of one "
+                    "fused apply",
+                    hint="route through the fused applier (stacked "
+                         "same-shape groups) or move the update into "
+                         "the compiled step; annotate deliberate "
+                         "fallback paths"))
+        return out
+
+    @staticmethod
+    def _updater_call(body):
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                parts = dotted_parts(sub.func)
+                if parts and _UPDATER_RE.match(parts[-1]):
+                    return sub
+        return None
+
+
+PASS = Pass()

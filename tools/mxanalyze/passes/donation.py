@@ -1,0 +1,239 @@
+"""donation-hazard: buffer donation without the policy point, and
+use-after-donation.
+
+``compiled.donate_argnums_for(ctx, argnums)`` is the repo's SINGLE
+donation decision point: it strips the donation set on backends without
+donation (CPU) so the same step code runs everywhere, and it is where
+MXNET_SPMD_DONATE-style policy lands. Two hazards around it:
+
+1. a jit/tracked_jit/CompiledProgram wrap site passing a NON-EMPTY
+   ``donate_argnums`` that did not route through
+   ``donate_argnums_for`` — on CPU the raw set either errors or
+   silently no-ops depending on jax version, and policy knobs stop
+   applying. The literal empty tuple ``()`` is fine (no donation).
+2. use-after-donation: after calling a jitted callable whose wrap site
+   donates argument position ``i``, the OLD buffer passed at ``i`` is
+   dead — a later read of that name observes a deleted array on real
+   backends (and version-dependent behavior elsewhere). Donated
+   positions are resolved from the wrap site (literal tuple, either
+   branch of a conditional, or the second argument of
+   ``donate_argnums_for``) and joined to call sites through the
+   assigned callable name.
+
+``mxnet_tpu/compiled.py`` itself is exempt: it DEFINES the policy point
+and forwards the already-decided set into ``jax.jit``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import dotted_parts, dotted_str, jit_index, literal_int_seq
+from .retrace import _expr_walk, _stmts_in_order
+
+RULE = "donation-hazard"
+
+_ROUTER = "donate_argnums_for"
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _enclosing_fn_map(tree):
+    """node id -> nearest enclosing FunctionDef."""
+    out = {}
+    for fn in _functions(tree):
+        for sub in ast.walk(fn):
+            out[id(sub)] = fn   # later (inner) fns overwrite — nearest
+    return out
+
+
+def _is_router_call(node):
+    return isinstance(node, ast.Call) \
+        and dotted_parts(node.func)[-1:] == [_ROUTER]
+
+
+def _assigns_to(fn, name):
+    """Value expressions assigned to ``name`` inside ``fn`` (or the
+    whole module when fn is None)."""
+    vals = []
+    scope = fn if fn is not None else None
+    if scope is None:
+        return vals
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    vals.append(node.value)
+    return vals
+
+
+def _donated_positions(value, fn, seen=None):
+    """Union of argument positions ``value`` can donate; None when the
+    expression is unresolvable (dynamic). Resolves literals, both arms
+    of an IfExp, the router's second argument, and local Name
+    assignments. ``seen`` breaks `donate = router(ctx, donate)`
+    self-reference cycles; an unresolvable VARIANT of a name is skipped
+    rather than poisoning the union (linter over-approximation)."""
+    seen = frozenset() if seen is None else seen
+    seq = literal_int_seq(value)
+    if seq is not None:
+        return set(seq)
+    if isinstance(value, ast.IfExp):
+        a = _donated_positions(value.body, fn, seen)
+        b = _donated_positions(value.orelse, fn, seen)
+        if a is None or b is None:
+            return None
+        return a | b
+    if _is_router_call(value):
+        if len(value.args) >= 2:
+            return _donated_positions(value.args[1], fn, seen)
+        return None
+    if isinstance(value, ast.Name) and fn is not None:
+        if value.id in seen:
+            return None
+        pos, resolved = set(), False
+        for v in _assigns_to(fn, value.id):
+            p = _donated_positions(v, fn, seen | {value.id})
+            if p is None:
+                continue
+            resolved = True
+            pos |= p
+        return pos if resolved else None
+    return None
+
+
+def _routed(value, fn, depth=0):
+    """True when the donate_argnums expression went through the
+    policy router: a router call, the empty tuple (explicit
+    no-donation), a conditional whose every arm is one of those, or a
+    name assigned from one."""
+    if depth > 4:
+        return False
+    if _is_router_call(value):
+        return True
+    if literal_int_seq(value) == []:
+        return True   # `router(...) if cond else ()` arms
+    if isinstance(value, ast.IfExp):
+        return _routed(value.body, fn, depth + 1) \
+            and _routed(value.orelse, fn, depth + 1)
+    if isinstance(value, ast.Name) and fn is not None:
+        return any(_routed(v, fn, depth + 1)
+                   for v in _assigns_to(fn, value.id))
+    return False
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if not mod.relpath.startswith("mxnet_tpu/"):
+                continue
+            if mod.relpath == "mxnet_tpu/compiled.py":
+                continue   # defines the router; forwards decided sets
+            index = jit_index(mod)
+            enclosing = _enclosing_fn_map(mod.tree)
+            donating_names = {}
+            for call in index.wrap_calls:
+                findings.extend(self._check_wrap(
+                    mod, call, enclosing, donating_names))
+            findings.extend(self._check_use_after(
+                mod, donating_names))
+        return findings
+
+    # (1) unrouted donation at the wrap site
+    def _check_wrap(self, mod, call, enclosing, donating_names):
+        out = []
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            fn = enclosing.get(id(call))
+            seq = literal_int_seq(kw.value)
+            if seq == []:
+                continue   # donate_argnums=() — explicit no-donation
+            if not _routed(kw.value, fn):
+                out.append(Finding(
+                    RULE, mod.relpath, kw.value.lineno,
+                    kw.value.col_offset,
+                    "donate_argnums bypasses donate_argnums_for: the "
+                    "donation set is not stripped on CPU backends and "
+                    "ignores the repo-wide donation policy",
+                    hint="wrap the set: donate_argnums="
+                         "compiled.donate_argnums_for(ctx, <set>)"))
+            # even an unrouted site participates in use-after checks
+            pos = _donated_positions(kw.value, fn)
+            if pos:
+                self._note_donating_name(call, mod, pos, donating_names)
+        return out
+
+    @staticmethod
+    def _note_donating_name(call, mod, pos, donating_names):
+        """Record ``name -> donated positions`` for every name the wrap
+        result is assigned to (`step_fn = tracked_jit(..., donate...)`)."""
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for tgt in node.targets:
+                    name = dotted_str(tgt)
+                    if name:
+                        donating_names.setdefault(name, set()).update(pos)
+
+    # (2) reads of a donated buffer after the donating call
+    def _check_use_after(self, mod, donating_names):
+        out = []
+        if not donating_names:
+            return out
+        # names flow through containers (fused_plan tuples); track by
+        # BARE tail too: `step_fn = tracked_jit(...)` rebound via
+        # `..., step_fn, _ = self._fused_plan` keeps the name
+        tails = {}
+        for name, pos in donating_names.items():
+            tails.setdefault(name.split(".")[-1], set()).update(pos)
+        for fn in _functions(mod.tree):
+            donated_vars = {}   # var name -> (callee, lineno)
+            for stmt in _stmts_in_order(fn.body):
+                for node in _expr_walk(stmt):
+                    if isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and node.id in donated_vars:
+                        callee, _ln = donated_vars[node.id]
+                        out.append(Finding(
+                            RULE, mod.relpath, node.lineno,
+                            node.col_offset,
+                            "use after donation: '%s' was donated to "
+                            "'%s' — the old buffer is deleted once the "
+                            "dispatch runs with donation enabled"
+                            % (node.id, callee),
+                            hint="read the value BEFORE the donating "
+                                 "call, or use the program's returned "
+                                 "buffer"))
+                        del donated_vars[node.id]   # one report per use
+                for node in _expr_walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted_str(node.func)
+                    pos = donating_names.get(name)
+                    if pos is None and isinstance(node.func, ast.Name):
+                        pos = tails.get(node.func.id)
+                    if not pos:
+                        continue
+                    for i in pos:
+                        if i < len(node.args) and isinstance(
+                                node.args[i], ast.Name):
+                            donated_vars[node.args[i].id] = (
+                                name or node.func.id, node.lineno)
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        for sub in ast.walk(tgt):
+                            if isinstance(sub, ast.Name):
+                                donated_vars.pop(sub.id, None)
+        return out
+
+
+PASS = Pass()

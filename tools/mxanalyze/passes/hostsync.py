@@ -1,0 +1,273 @@
+"""host-sync-hazard: device->host synchronization in hot loops.
+
+The async-dispatch pipeline (stepprof's whole premise) dies the moment a
+hot-path statement forces a device value back to the host: every queued
+step drains, dispatch serializes, and the profiler books the stall as
+``host_block``. This pass finds the source patterns BEFORE a profile
+run does:
+
+1. ``.asnumpy()`` / ``.item()`` / ``np.asarray(x)`` / ``float(x)`` /
+   ``int(x)`` on a device-tainted value inside a designated hot
+   function (fit/step/update/serving loops). ``asnumpy``/``item`` are
+   unconditional sinks in hot scope — on this codebase they only exist
+   on NDArray; the scalar coercions and ``np.asarray`` flag only when
+   taint says the operand came off a device (result of a jitted
+   callable, ``forward``/``get_outputs``-style producer, or ``.outputs``
+   read), so ``float(cfg["lr"])`` stays silent.
+2. branching (``if``/``while``) on a device-tainted value — a hidden
+   sync plus a trace-invalidation hazard in one.
+3. ``block_until_ready`` in a hot function OUTSIDE a
+   ``stepprof.should_sync()`` bracket — the sampled-sync discipline
+   (MXNET_STEPPROF_SYNC_EVERY) exists precisely so full-fence syncs are
+   paid on 1/N steps; an unguarded fence pays it every step.
+
+Scope is deliberately narrow: only the hot-path modules and function
+names below. ``metric.py`` is excluded on purpose — metric readback is
+booked as ``device_compute`` by design (see stepprof docs), and
+update_metric sits outside the dispatch hot window.
+
+Legitimate syncs (API boundaries returning numpy, final-loss readback)
+get ``# mxanalyze: allow(host-sync-hazard): <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import dotted_parts, jit_index
+from .retrace import _expr_walk, _stmts_in_order
+
+RULE = "host-sync-hazard"
+
+#: module prefixes whose hot functions are in scope
+HOT_PREFIXES = (
+    "mxnet_tpu/module/",
+    "mxnet_tpu/gluon/trainer.py",
+    "mxnet_tpu/serving/",
+    "mxnet_tpu/executor.py",
+    "mxnet_tpu/executor_manager.py",
+    "mxnet_tpu/model.py",
+    "mxnet_tpu/parallel/data_parallel.py",
+)
+
+#: function names that constitute the step/fit/serving hot loops
+HOT_FUNCTIONS = {
+    "fit", "_fit_loop", "score", "predict", "iter_predict",
+    "forward", "backward", "forward_backward", "update", "_update",
+    "_update_impl", "_allreduce_grads", "step", "_step", "_step_scan",
+    "train_step", "__call__", "stack_batches", "_stack", "_load_batch",
+    "_batch_loop", "submit", "run_batch", "_run_batch", "_dispatch",
+}
+
+#: unconditional sinks in hot scope — these methods only exist on
+#: device arrays in this codebase
+_SYNC_METHODS = {"asnumpy", "item"}
+
+#: coercions that sync ONLY when the operand is device-tainted
+_COERCIONS = {"float", "int", "bool"}
+
+#: callables whose RESULT is device data (taint sources), beyond
+#: jitted names from the module's JitIndex
+_DEVICE_PRODUCER_TAILS = {"forward", "get_outputs", "forward_backward",
+                          "output_dict", "outputs"}
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _mentions_should_sync(test):
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if parts and parts[-1] == "should_sync":
+                return True
+    return False
+
+
+class _DeviceTaint:
+    """Forward taint: which local names hold device values."""
+
+    def __init__(self, jitted_names):
+        self.tainted = set()
+        self.jitted_names = jitted_names
+
+    def expr_tainted(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            # module.get_outputs() style producers handled in Call;
+            # `exec.outputs` / `self.outputs` reads are device lists
+            return node.attr == "outputs"
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            parts = dotted_parts(node.func)
+            if not parts:
+                return False
+            dotted = ".".join(parts)
+            if dotted in self.jitted_names:
+                return True
+            if parts[-1] in _DEVICE_PRODUCER_TAILS:
+                return True
+            return False
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self.expr_tainted(node.left) \
+                or self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.expr_tainted(node.left) \
+                or any(self.expr_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.expr_tainted(node.body) \
+                or self.expr_tainted(node.orelse)
+        return False
+
+    def note_assign(self, node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if self.expr_tainted(node.value):
+                    self.tainted.add(tgt.id)
+                else:
+                    self.tainted.discard(tgt.id)
+            elif isinstance(tgt, ast.Tuple) \
+                    and self.expr_tainted(node.value):
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        self.tainted.add(e.id)
+
+
+def _np_asarray(call):
+    parts = dotted_parts(call.func)
+    return len(parts) >= 2 and parts[-1] in ("asarray", "array") \
+        and parts[-2] in ("np", "numpy", "_np", "onp")
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if not any(mod.relpath == p or mod.relpath.startswith(p)
+                       for p in HOT_PREFIXES):
+                continue
+            index = jit_index(mod)
+            jitted = set(index.jitted_names)
+            jitted_defs = {id(d) for d in index.jitted_defs}
+            for fn in _functions(mod.tree):
+                if fn.name not in HOT_FUNCTIONS:
+                    continue
+                if id(fn) in jitted_defs:
+                    continue   # traced bodies never sync at step time
+                findings.extend(self._check_fn(mod, fn, jitted))
+        return findings
+
+    def _check_fn(self, mod, fn, jitted_names):
+        out = []
+        taint = _DeviceTaint(jitted_names)
+
+        def check_expr(node, guarded):
+            if isinstance(node, ast.Call):
+                parts = dotted_parts(node.func)
+                tail = parts[-1] if parts else ""
+                if tail in _SYNC_METHODS \
+                        and isinstance(node.func, ast.Attribute):
+                    out.append(Finding(
+                        RULE, mod.relpath, node.lineno, node.col_offset,
+                        "device->host sync: .%s() inside hot function "
+                        "'%s' drains the dispatch pipeline every call"
+                        % (tail, fn.name),
+                        hint="keep the value on device (jnp ops), batch "
+                             "the readback outside the loop, or annotate "
+                             "`# mxanalyze: allow(host-sync-hazard): "
+                             "<reason>`"))
+                    return
+                if tail == "block_until_ready":
+                    if not guarded:
+                        out.append(Finding(
+                            RULE, mod.relpath, node.lineno,
+                            node.col_offset,
+                            "unsampled block_until_ready in hot "
+                            "function '%s': full fence every step "
+                            "instead of 1/SYNC_EVERY" % fn.name,
+                            hint="guard with `if stepprof."
+                                 "should_sync():` or annotate the "
+                                 "deliberate fence"))
+                    return
+                if (tail in _COERCIONS and len(parts) == 1
+                        and node.args
+                        and taint.expr_tainted(node.args[0])):
+                    out.append(Finding(
+                        RULE, mod.relpath, node.lineno, node.col_offset,
+                        "%s() on a device value inside hot function "
+                        "'%s' forces a blocking transfer" % (tail,
+                                                             fn.name),
+                        hint="compute on device and read back once per "
+                             "SYNC_EVERY steps"))
+                    return
+                if _np_asarray(node) and node.args \
+                        and taint.expr_tainted(node.args[0]):
+                    out.append(Finding(
+                        RULE, mod.relpath, node.lineno, node.col_offset,
+                        "np.asarray on a device value inside hot "
+                        "function '%s' copies device->host every call"
+                        % fn.name,
+                        hint="stay in jnp, or move the conversion out "
+                             "of the hot loop"))
+                    return
+
+        def walk_stmts(body, guarded):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                g = guarded
+                if isinstance(stmt, (ast.If, ast.While)):
+                    if _mentions_should_sync(stmt.test):
+                        g = True
+                    elif taint.expr_tainted(stmt.test):
+                        out.append(Finding(
+                            RULE, mod.relpath, stmt.test.lineno,
+                            stmt.test.col_offset,
+                            "branch on a device value inside hot "
+                            "function '%s': the comparison blocks on "
+                            "the transfer" % fn.name,
+                            hint="branch on host metadata, or use "
+                                 "lax.cond inside the compiled step"))
+                for node in _expr_walk(stmt):
+                    check_expr(node, g)
+                taint.note_assign(stmt)
+                for _field, value in ast.iter_fields(stmt):
+                    if isinstance(value, list) and value and isinstance(
+                            value[0], (ast.stmt, ast.ExceptHandler)):
+                        inner = []
+                        for v in value:
+                            if isinstance(v, ast.ExceptHandler):
+                                inner.extend(v.body)
+                            else:
+                                inner.append(v)
+                        walk_stmts(inner, g)
+
+        walk_stmts(fn.body, False)
+        # dedupe: nested statement walk can visit an expr twice when a
+        # compound statement holds both test and body exprs
+        seen, uniq = set(), []
+        for f in out:
+            key = (f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        return uniq
+
+
+PASS = Pass()

@@ -1,0 +1,172 @@
+"""sharding-reachability: specs that never constrain anything, and
+parallel modules no frontend can reach.
+
+The runtime complement is shardprof's placement audit (flagged
+replicated params, bad_rows); this pass catches the same
+silent-replication class before a run:
+
+1. dead spec: a name assigned from ``PartitionSpec(...)`` / ``P(...)``
+   / ``policy.param_spec(...)`` that is never read afterwards — the
+   spec was constructed but reaches no placement sink (NamedSharding /
+   device_put / in_shardings / with_sharding_constraint), so the
+   parameter it described stays replicated without a word.
+2. dead public surface: a module under ``mxnet_tpu/parallel/`` whose
+   public names are referenced by NOTHING in the analyzed tree except
+   the package ``__init__`` re-export — a parallelism feature that no
+   frontend (module/gluon/serving) can reach is integration debt
+   (ROADMAP item 2), surfaced here so it is either wired up or
+   annotated, not silently shipped.
+
+The dead-surface rule only fires when the analyzed project actually
+contains frontend modules (something under ``mxnet_tpu/`` outside
+``parallel/``) — a single-file or ``--changed-only`` run must not call
+everything dead for lack of visible callers.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import dotted_parts, import_aliases
+
+RULE = "sharding-reachability"
+
+_SPEC_TAILS = {"PartitionSpec", "param_spec", "batch_spec"}
+_PKG = "mxnet_tpu/parallel/"
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _public_names(mod):
+    """__all__ when declared, else top-level public defs/classes."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    return {e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+    return {n.name for n in mod.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+            and not n.name.startswith("_")}
+
+
+def _referenced_tokens(mod):
+    """Every identifier ``mod`` could be reaching another module by:
+    import target segments, attribute names, bare names."""
+    toks = set()
+    for target in import_aliases(mod.tree).values():
+        toks.update(target.split("."))
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute):
+            toks.add(node.attr)
+        elif isinstance(node, ast.Name):
+            toks.add(node.id)
+    return toks
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        findings = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if mod.relpath.startswith("mxnet_tpu/"):
+                findings.extend(self._check_dead_specs(mod))
+        findings.extend(self._check_dead_surface(project))
+        return findings
+
+    # (1) spec constructed but never read
+    def _check_dead_specs(self, mod):
+        out = []
+        aliases = import_aliases(mod.tree)
+        spec_ctors = set(_SPEC_TAILS)
+        for name, target in aliases.items():
+            if target.split(".")[-1] in ("PartitionSpec",):
+                spec_ctors.add(name)   # `from jax.sharding import
+                # PartitionSpec as P` makes bare P(...) a spec ctor
+        for fn in _functions(mod.tree):
+            assigns = []   # (name, assign node)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    parts = dotted_parts(node.value.func)
+                    if parts and parts[-1] in spec_ctors:
+                        assigns.append((node.targets[0].id, node))
+            for name, node in assigns:
+                used = any(
+                    isinstance(sub, ast.Name) and sub.id == name
+                    and isinstance(sub.ctx, ast.Load)
+                    and (sub.lineno, sub.col_offset)
+                    > (node.lineno, node.col_offset)
+                    for sub in ast.walk(fn))
+                if not used:
+                    out.append(Finding(
+                        RULE, mod.relpath, node.lineno, node.col_offset,
+                        "sharding spec '%s' is constructed but never "
+                        "reaches a placement sink — the array it "
+                        "describes stays silently replicated" % name,
+                        hint="apply it (NamedSharding/device_put/"
+                             "in_shardings/with_sharding_constraint) "
+                             "or delete it"))
+        return out
+
+    # (2) parallel module unreachable from any frontend
+    def _check_dead_surface(self, project):
+        out = []
+        candidates, referencers, frontends = [], [], 0
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            if mod.relpath.startswith(_PKG):
+                if mod.stem != "__init__" \
+                        and not mod.stem.startswith("_"):
+                    candidates.append(mod)
+                if mod.stem != "__init__":
+                    referencers.append(mod)
+            elif mod.relpath.startswith("mxnet_tpu/"):
+                referencers.append(mod)
+                frontends += 1
+        if not candidates or not frontends:
+            return out
+        for mod in candidates:
+            public = _public_names(mod)
+            reach = public | {mod.stem}
+            reached = False
+            for other in referencers:
+                if other is mod:
+                    continue
+                if reach & _referenced_tokens(other):
+                    reached = True
+                    break
+            if not reached:
+                line = 1
+                for node in mod.tree.body:   # anchor on __all__ if any
+                    if isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Name)
+                            and t.id == "__all__"
+                            for t in node.targets):
+                        line = node.lineno
+                        break
+                out.append(Finding(
+                    RULE, mod.relpath, line, 0,
+                    "public surface (%s) is unreachable from any "
+                    "frontend: only the package __init__ re-exports it"
+                    % ", ".join(sorted(public)[:4] + (
+                        ["..."] if len(public) > 4 else [])),
+                    hint="wire it into a frontend path (ROADMAP item "
+                         "2) or annotate the integration debt"))
+        return out
+
+
+PASS = Pass()

@@ -1,0 +1,182 @@
+"""``--profile``: join static findings with runtime profiler verdicts.
+
+Reads the host snapshots the observability stack drops into a
+telemetry dir (``telemetry.write_host_json`` transport):
+
+- ``stepprof_host<h>_pid<p>.json``  — step anatomy; carries a
+  ``verdict`` (input-bound / dispatch-bound / sync-bound /
+  compute-bound / comm-bound) and ``hint``.
+- ``shardprof_host<h>_pid<p>.json`` — sharding anatomy; the placement
+  ``audit`` (flagged replicated params) and predicted ``comm``
+  (overlap_fraction) synthesize verdicts here.
+- ``runprof_i<r>_host<h>_pid<p>.json`` — run anatomy; the verdict is
+  re-derived from ``states``/``goodput_fraction`` with the same
+  dominant-badput rule as ``runprof.classify`` (re-implemented on
+  purpose: the analyzer never imports the analyzed code).
+
+Each verdict then ESCALATES the static findings that explain it — a
+dispatch-bound step promotes ``dispatch-amplification`` findings in the
+hot path to error severity, even when they are baselined (runtime
+evidence says that debt is THE bottleneck now, so the baseline's
+amnesty no longer applies). The CLI emits a BENCH-style
+``mxanalyze_perf_gate`` line and fails when anything escalated.
+
+Pure stdlib; snapshots are read with ``json`` only.
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+
+#: runprof's healthy-goodput floor and badput-state verdict names,
+#: mirrored (NOT imported — see module docstring)
+_HEALTHY_GOODPUT = 0.9
+_STATE_VERDICT = {
+    "init": "init-heavy",
+    "compile": "compile-heavy",
+    "checkpoint_save": "checkpoint-heavy",
+    "checkpoint_restore": "checkpoint-heavy",
+    "recovery": "recovery-heavy",
+    "input_stall": "input-bound",
+    "idle": "idle-heavy",
+}
+
+#: overlap below this fraction reads "collectives exposed on the step
+#: critical path" (matches shardprof's overlap guidance)
+_LOW_OVERLAP = 0.5
+
+#: verdict -> (rules to escalate, repo-path prefixes the finding must
+#: sit under). The prefixes keep a dispatch-bound verdict from
+#: promoting, say, a serving-only finding.
+_STEP_PATHS = ("mxnet_tpu/module/", "mxnet_tpu/executor",
+               "mxnet_tpu/optimizer.py", "mxnet_tpu/gluon/trainer.py",
+               "mxnet_tpu/parallel/")
+_ANY = ("mxnet_tpu/",)
+ESCALATIONS = {
+    "dispatch-bound": (("dispatch-amplification",), _STEP_PATHS),
+    "sync-bound": (("host-sync-hazard",), _ANY),
+    "input-bound": (("host-sync-hazard",), _ANY),
+    "comm-bound": (("donation-hazard", "sharding-reachability"), _ANY),
+    "replicated-params": (("sharding-reachability",), _ANY),
+    "unoverlapped-comm": (("donation-hazard",
+                           "sharding-reachability"), _ANY),
+    "compile-heavy": (("retrace-hazard",), _ANY),
+}
+
+
+def _read_json(path):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def snapshot_files(dirpath):
+    """The profiler host snapshots present under ``dirpath``, by kind."""
+    out = {"stepprof": [], "shardprof": [], "runprof": []}
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        if fnmatch.fnmatch(fn, "stepprof_host*.json"):
+            out["stepprof"].append(os.path.join(dirpath, fn))
+        elif fnmatch.fnmatch(fn, "shardprof_host*.json"):
+            out["shardprof"].append(os.path.join(dirpath, fn))
+        elif fnmatch.fnmatch(fn, "runprof*_host*.json") \
+                and "progress" not in fn:
+            out["runprof"].append(os.path.join(dirpath, fn))
+    return out
+
+
+def has_snapshots(dirpath):
+    return any(snapshot_files(dirpath).values())
+
+
+def read_verdicts(dirpath):
+    """Every runtime verdict found in ``dirpath``'s snapshots, as
+    ``{"verdict", "source", "file", "detail"}`` dicts (deduplicated by
+    verdict name, first source wins)."""
+    files = snapshot_files(dirpath)
+    verdicts = []
+
+    def add(verdict, source, path, detail=""):
+        if verdict and not any(v["verdict"] == verdict
+                               for v in verdicts):
+            verdicts.append({"verdict": verdict, "source": source,
+                             "file": os.path.basename(path),
+                             "detail": detail})
+
+    for path in files["stepprof"]:
+        doc = _read_json(path)
+        if not isinstance(doc, dict):
+            continue
+        add(doc.get("verdict"), "stepprof", path,
+            detail=doc.get("hint", ""))
+    for path in files["shardprof"]:
+        doc = _read_json(path)
+        if not isinstance(doc, dict):
+            continue
+        audit = doc.get("audit") or {}
+        if audit.get("flagged"):
+            add("replicated-params", "shardprof", path,
+                detail="%s param(s) flagged replicated by the "
+                       "placement audit" % audit.get("flagged"))
+        comm = doc.get("comm") or {}
+        ov = comm.get("overlap_fraction")
+        if isinstance(ov, (int, float)) and ov < _LOW_OVERLAP:
+            add("unoverlapped-comm", "shardprof", path,
+                detail="overlap_fraction %.2f: predicted collectives "
+                       "sit exposed on the step path" % ov)
+    for path in files["runprof"]:
+        doc = _read_json(path)
+        if not isinstance(doc, dict):
+            continue
+        states = doc.get("states") or {}
+        goodput = doc.get("goodput_fraction")
+        total = sum(v for v in states.values()
+                    if isinstance(v, (int, float)) and v > 0)
+        if total <= 0:
+            continue
+        if goodput is None:
+            goodput = states.get("train_productive", 0.0) / total
+        if goodput >= _HEALTHY_GOODPUT:
+            continue
+        badput = {s: v for s, v in states.items()
+                  if s != "train_productive"
+                  and isinstance(v, (int, float))}
+        if not badput:
+            continue
+        dominant = max(badput, key=lambda s: badput[s])
+        if badput[dominant] <= 0:
+            continue
+        add(_STATE_VERDICT.get(dominant), "runprof", path,
+            detail="goodput %.2f, dominant badput state '%s'"
+                   % (goodput, dominant))
+    return verdicts
+
+
+def escalate(findings, verdicts):
+    """Mark every finding a runtime verdict explains as escalated
+    (severity becomes error). Returns the escalated findings, sorted.
+    ``findings`` should be the FULL finding list (baselined included):
+    runtime evidence overrides the baseline's amnesty."""
+    escalated = []
+    for v in verdicts:
+        rule_paths = ESCALATIONS.get(v["verdict"])
+        if rule_paths is None:
+            continue
+        rules, prefixes = rule_paths
+        for f in findings:
+            if f.escalated or f.rule not in rules:
+                continue
+            if any(f.path == p or f.path.startswith(p)
+                   for p in prefixes):
+                f.escalated = v["verdict"]
+                escalated.append(f)
+    escalated.sort(key=lambda f: f.sort_key())
+    return escalated

@@ -46,12 +46,16 @@ def main(argv=None):
                     help="bench_gate regression threshold override")
     ap.add_argument("--paths", nargs="*", default=None,
                     help="paths for mxanalyze (default: mxnet_tpu/)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scope mxanalyze to files git reports changed "
+                         "(fast incremental gate, same exit codes)")
     args = ap.parse_args(argv)
 
     sys.path.insert(0, REPO)
     from tools.mxanalyze.cli import main as mxanalyze_main
 
-    mx_args = ["--strict"] + (args.paths or [])
+    mx_args = ["--strict"] + (["--changed-only"] if args.changed_only
+                              else []) + (args.paths or [])
     rc = mxanalyze_main(mx_args)
 
     if args.bench is not None:
@@ -62,6 +66,14 @@ def main(argv=None):
         else:
             with open(args.bench, "r", encoding="utf-8") as fh:
                 lines = fh.read().splitlines()
+            # MXNET_TELEMETRY_DIR-style snapshots sitting next to the
+            # bench records carry runtime verdicts: cross-check the
+            # static findings against them (mxanalyze_perf_gate)
+            from tools.mxanalyze import profiles
+            bench_dir = os.path.dirname(os.path.abspath(args.bench))
+            if profiles.has_snapshots(bench_dir):
+                rc = max(rc, mxanalyze_main(
+                    ["--profile", bench_dir] + (args.paths or [])))
         records = bench_gate.parse_lines(lines)
         kwargs = {}
         if args.threshold is not None:
