@@ -48,7 +48,7 @@ import os
 import threading
 import time
 
-from . import telemetry
+from . import telemetry, threadsan
 
 __all__ = ["CompiledProgram", "tracked_jit", "aot_compile",
            "donate_argnums_for", "spmd_donate_enabled",
@@ -56,7 +56,7 @@ __all__ = ["CompiledProgram", "tracked_jit", "aot_compile",
 
 logger = logging.getLogger("mxnet_tpu.compiled")
 
-_lock = threading.RLock()
+_lock = threadsan.register("compiled._lock", threading.RLock())
 _sites = {}    # (site, lineage) -> {"compiles": int, "sig": dict or None}
 _state = {"last_retrace": None}
 
@@ -340,7 +340,13 @@ class CompiledProgram:
         self._fn = jax.jit(fun, static_argnums=tuple(static_argnums),
                            **jit_kwargs)
         self._cache = {}
-        self._compile_lock = threading.Lock()
+        # dispatch_ok: this lock EXISTS to serialize compiles, and a
+        # compile traces the user fn — which may dispatch a nested
+        # CompiledProgram (gluon block inside a fused step). That is the
+        # double-checked cache working as designed, not a stall hazard.
+        self._compile_lock = threadsan.register(
+            "compiled.CompiledProgram._compile_lock", threading.Lock(),
+            dispatch_ok=True)
         self.last_flops = None
         self.last_memory = None
 
@@ -375,6 +381,8 @@ class CompiledProgram:
 
     def __call__(self, *args, **kwargs):
         import jax
+        if threadsan.ARMED:   # one attribute read when off
+            threadsan.note_dispatch("compiled.%s" % self.site)
         if kwargs or not jax.core.trace_state_clean():
             # called inside an outer trace (vjp/scan over a compiled
             # program) or with kwargs: the plain dispatch path handles both

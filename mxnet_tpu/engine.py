@@ -89,6 +89,9 @@ def fence(arrs):
     O(signatures x log n), not O(arrays) — on the ~40ms-per-dispatch axon
     tunnel a 100-buffer waitall stays a handful of dispatches plus ONE
     ~90ms readback per device."""
+    from . import threadsan
+    if threadsan.ARMED:   # one attribute read when off
+        threadsan.note_dispatch("engine.fence", kind="sync")
     import numpy as np
     by_dev = {}
     for a in arrs:

@@ -69,9 +69,12 @@ class DataLoader:
     def _threaded_iter(self):
         batches = list(self._batch_sampler)
         out_q = _queue.Queue(maxsize=2 * self._num_workers)
+        # reorder state (results/next_idx) is touched ONLY by the
+        # consuming thread; workers hand finished batches over through
+        # out_q and hold no lock across batchify (which may dispatch a
+        # device transfer) — the queues are the whole synchronization
         results = {}
         next_idx = [0]
-        lock = threading.Lock()
         job_q = _queue.Queue()
         for i, b in enumerate(batches):
             job_q.put((i, b))

@@ -21,6 +21,7 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import threadsan
 from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray, array
@@ -311,6 +312,12 @@ class PrefetchingIter(DataIter):
         self._queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = None
+        # the error handoff is the ONLY shared mutable state between the
+        # producer and the consumer that the queue itself does not order;
+        # its lock guards exactly the flag — never the wrapped iterator's
+        # batch construction or the queue put (which may device-transfer)
+        self._err_lock = threadsan.register(
+            "io.PrefetchingIter._err_lock", threading.Lock())
         self._error = None
         self.current_batch = None
         from . import telemetry
@@ -380,7 +387,8 @@ class PrefetchingIter(DataIter):
             # a mid-epoch crash of the wrapped iterator must surface in
             # iter_next(), NOT masquerade as a clean end-of-epoch (silent
             # data truncation)
-            self._error = exc
+            with self._err_lock:
+                self._error = exc
         finally:
             self._put(queue, None)
 
@@ -417,7 +425,8 @@ class PrefetchingIter(DataIter):
                     "PrefetchingIter.reset: producer thread did not "
                     "exit within %gs (MXNET_PREFETCH_JOIN_TIMEOUT); "
                     "the wrapped iterator is wedged" % budget)
-        self._error = None
+        with self._err_lock:
+            self._error = None
         self.iters[0].reset()
         self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
@@ -427,8 +436,9 @@ class PrefetchingIter(DataIter):
         batch = self._queue.get()
         self._wait_consumer.observe(time.monotonic() - t0)
         if batch is None:
-            if self._error is not None:
+            with self._err_lock:
                 err, self._error = self._error, None
+            if err is not None:
                 raise err
             return False
         self.current_batch = batch

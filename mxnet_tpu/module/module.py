@@ -707,6 +707,10 @@ class Module(BaseModule):
             # step (the overlap estimator's ground truth); off the
             # sampled steps the device runs hidden behind host phases
             import jax
+            from .. import threadsan
+            if threadsan.ARMED:
+                threadsan.note_dispatch("module._step.sampled_sync",
+                                        kind="sync")
             with stepprof.phase("device_compute", synced=True) as _dc:
                 jax.block_until_ready((outs, new_ws))
             stepprof.note_device_sample(
@@ -986,6 +990,10 @@ class Module(BaseModule):
         if stepprof.should_sync():
             # sampled sync (see _step): one real device wait covering
             # the whole K-batch dispatch
+            from .. import threadsan
+            if threadsan.ARMED:
+                threadsan.note_dispatch("module._step_scan.sampled_sync",
+                                        kind="sync")
             with stepprof.phase("device_compute", synced=True,
                                 batches=K) as _dc:
                 jax.block_until_ready((ga, outs))

@@ -51,6 +51,8 @@ import time
 
 import numpy as np
 
+from .. import threadsan
+
 __all__ = ["AsyncPSServer", "AsyncPSClient", "serve_forever"]
 
 _HDR = struct.Struct("<Q")
@@ -177,7 +179,10 @@ class AsyncPSServer:
         self.states = {}           # key -> optimizer state (np arrays)
         self.heartbeats = {}       # rank -> last monotonic time
         self.staleness = staleness
-        self._global_lock = threading.Lock()
+        self._global_lock = threadsan.register(
+            "ps_async.AsyncPSServer._global_lock", threading.Lock())
+        # the Condition rides the (possibly witness-wrapped) global lock,
+        # so its acquire/release already land in the same bookkeeping
         self._cv = threading.Condition(self._global_lock)
 
     # -- handlers --------------------------------------------------------
@@ -194,7 +199,9 @@ class AsyncPSServer:
             with self._global_lock:
                 if key not in self.store:   # first writer wins (reference
                     self.store[key] = np.array(payload)   # InitImpl)
-                    self.locks[key] = threading.Lock()
+                    self.locks[key] = threadsan.register(
+                        "ps_async.AsyncPSServer.key_lock",
+                        threading.Lock())
                     self.push_counts[key] = {}
             return ok
         if op == "push":
@@ -309,7 +316,8 @@ class AsyncPSClient:
             addr = (host, port)
         self.rank = rank
         self._sock = socket.create_connection(addr, timeout=120)
-        self._lock = threading.Lock()
+        self._lock = threadsan.register("ps_async.AsyncPSClient._lock",
+                                        threading.Lock())
 
     def _rpc(self, hdr, payload=b""):
         with self._lock:

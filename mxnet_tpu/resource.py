@@ -33,6 +33,7 @@ from .base import MXNetError
 from .context import Context, current_context
 from . import random as _random
 from . import storage as _storage
+from . import threadsan
 
 __all__ = ["ResourceRequest", "Resource", "ResourceManager", "request"]
 
@@ -60,7 +61,8 @@ class Resource:
         self.req = req
         self.ctx = ctx
         self._slot = slot
-        self._lock = threading.Lock()
+        self._lock = threadsan.register("resource.Resource._lock",
+                                        threading.Lock())
         self._key = None
         self._space = None
 
@@ -134,7 +136,8 @@ class ResourceManager:
     resources per device; pool size = ``MXNET_EXEC_NUM_TEMP``)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threadsan.register("resource.ResourceManager._lock",
+                                        threading.Lock())
         self._pools = {}   # (ctx, type) -> [Resource]
         self._next = {}    # (ctx, type) -> rotation index
 

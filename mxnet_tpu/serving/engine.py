@@ -72,6 +72,7 @@ import numpy as np
 
 from .. import chaos
 from .. import telemetry
+from .. import threadsan
 from .. import xla_stats
 from ..base import MXNetError
 from ..predict import Predictor
@@ -257,7 +258,8 @@ class InferenceEngine:
         self._work = _queue.Queue(maxsize=len(self._replicas))
         self._batch_seq = itertools.count(1)   # batch ids for span linkage
         self._slo = reqtrace.SLOTracker()
-        self._cond = threading.Condition()
+        self._cond = threadsan.register(
+            "engine.InferenceEngine._cond", threading.Condition())
         self._pending = 0          # submitted, not yet resolved
         self._draining = False
         self._closed = False

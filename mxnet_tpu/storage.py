@@ -28,6 +28,7 @@ import threading
 
 import numpy as np
 
+from . import threadsan
 from .base import MXNetError
 from .context import Context, cpu, current_context
 
@@ -70,7 +71,8 @@ class _HostPool:
     mutex-guarded manager."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threadsan.register("storage._HostPool._lock",
+                                        threading.Lock())
         self._free = {}          # rounded size -> [np buffers]
         self._cached_bytes = 0
         self.num_allocs = 0

@@ -59,9 +59,25 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "to_chrome", "merge", "add_tap", "remove_tap", "swallowed",
            "write_host_json", "merge_host_json", "env_int", "env_float"]
 
+try:
+    from . import threadsan
+except ImportError:
+    # Loaded standalone (tools/merge_traces.py execs this file outside
+    # the package so it stays jax-free): the merger only READS telemetry
+    # dirs and never arms the sanitizer, so a passthrough register keeps
+    # this module stdlib-self-contained.
+    class _ThreadsanOff:
+        ARMED = False
+
+        @staticmethod
+        def register(name, lock):
+            return lock
+
+    threadsan = _ThreadsanOff()
+
 _logger = logging.getLogger("mxnet_tpu.telemetry")
 
-_lock = threading.RLock()
+_lock = threadsan.register("telemetry._lock", threading.RLock())
 _metrics = {}   # (name, label_items) -> metric
 _kinds = {}     # name -> (kind, help)
 

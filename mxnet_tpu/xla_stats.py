@@ -86,7 +86,7 @@ import threading
 import time
 from collections import deque
 
-from . import telemetry
+from . import telemetry, threadsan
 
 __all__ = ["TrackedJit", "tracked_jit", "aot_compile", "compile_counts",
            "last_retrace",
@@ -99,7 +99,7 @@ __all__ = ["TrackedJit", "tracked_jit", "aot_compile", "compile_counts",
 
 logger = logging.getLogger("mxnet_tpu.xla_stats")
 
-_lock = threading.RLock()
+_lock = threadsan.register("xla_stats._lock", threading.RLock())
 _ledger = {}   # (scope, section) -> bytes
 _step = {"flops_per_batch": 0.0, "site": None, "batches": 0,
          "updated": 0.0}
@@ -475,7 +475,8 @@ class FlightRecorder:
             except ValueError:
                 maxlen = 256
         self._ring = deque(maxlen=max(8, maxlen))
-        self._lock = threading.Lock()
+        self._lock = threadsan.register(
+            "xla_stats.FlightRecorder._lock", threading.Lock())
         self.last = {"compile": None, "step": None}
         self.dumps_written = 0
 

@@ -1211,3 +1211,332 @@ class TestRepoGate:
             env=dict(os.environ, PYTHONPATH=REPO))
         assert r.returncode == 0, r.stdout + r.stderr
         assert "mxanalyze_perf_gate" not in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# cross-thread-state: thread roots, unlocked shared writes, bare waits
+# ---------------------------------------------------------------------------
+
+class TestCrossThreadState:
+    def test_unlocked_write_from_two_roots(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = False
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._done = True
+
+                def stop(self):
+                    self._done = True
+            """)
+        msgs = [f.message for f in fs if f.rule == "cross-thread-state"]
+        assert len(msgs) == 2, fs
+        assert all("Pump._done" in m for m in msgs)
+        assert all("Pump._run" in m and "main" in m for m in msgs)
+
+    def test_locked_writes_are_clean(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = False
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self._done = True
+
+                def stop(self):
+                    with self._lock:
+                        self._done = True
+            """)
+        assert [f for f in fs if f.rule == "cross-thread-state"] == []
+
+    def test_single_root_not_flagged(self, tmp_path):
+        # worker-only writes: one root, nothing cross-thread
+        fs = _analyze(tmp_path, """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._n = 0
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._n += 1
+            """)
+        assert [f for f in fs if f.rule == "cross-thread-state"] == []
+
+    def test_module_function_target_and_global(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            _state = {}
+
+            def worker():
+                _state["k"] = 1
+
+            def start():
+                threading.Thread(target=worker).start()
+                _state["k"] = 0
+            """)
+        msgs = [f.message for f in fs if f.rule == "cross-thread-state"]
+        assert len(msgs) == 2, fs
+        assert all("_state" in m and "worker" in m for m in msgs)
+
+    def test_root_propagates_through_helper(self, tmp_path):
+        # the worker loop writes via a helper: the helper inherits the
+        # worker root and the main-path write still makes it 2 roots
+        fs = _analyze(tmp_path, """
+            import threading
+            _state = {}
+
+            def _bump():
+                _state["k"] = 1
+
+            def worker():
+                _bump()
+
+            def start():
+                threading.Thread(target=worker).start()
+                _state["k"] = 0
+            """)
+        msgs = [f.message for f in fs if f.rule == "cross-thread-state"]
+        assert len(msgs) == 2, fs
+
+    def test_thread_subclass_run_is_a_root(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            _hits = 0
+
+            class W(threading.Thread):
+                def run(self):
+                    global _hits
+                    _hits += 1
+
+            def poke():
+                global _hits
+                _hits = 0
+            """)
+        msgs = [f.message for f in fs if f.rule == "cross-thread-state"]
+        assert len(msgs) == 2, fs
+        assert all("W.run" in m for m in msgs)
+
+    def test_suppression_holds(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+            _state = {}
+
+            def worker():
+                # mxanalyze: allow(cross-thread-state): handoff is ordered by the queue, single writer per key
+                _state["k"] = 1
+
+            def start():
+                threading.Thread(target=worker).start()
+                # mxanalyze: allow(cross-thread-state): runs before the thread starts
+                _state["k"] = 0
+            """)
+        assert [f for f in fs if f.rule == "cross-thread-state"] == []
+
+    def test_bare_condition_wait_flagged(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._cond:
+                        self._cond.notify()
+
+                def get(self):
+                    with self._cond:
+                        self._cond.wait()
+            """)
+        msgs = [f.message for f in fs if f.rule == "cross-thread-state"]
+        assert len(msgs) == 1, fs
+        assert "while" in msgs[0]
+
+    def test_predicate_loop_and_wait_for_are_clean(self, tmp_path):
+        fs = _analyze(tmp_path, """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._ready = False
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._cond:
+                        self._cond.notify()
+
+                def get(self):
+                    with self._cond:
+                        while not self._ready:
+                            self._cond.wait()
+
+                def get2(self):
+                    with self._cond:
+                        self._cond.wait_for(lambda: self._ready)
+            """)
+        assert [f for f in fs if f.rule == "cross-thread-state"] == []
+
+    def test_registered_lock_still_recognized(self, tmp_path):
+        # threadsan.register wrapping must not blind the lock table
+        fs = _analyze(tmp_path, """
+            import threading
+            from mxnet_tpu import threadsan
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threadsan.register(
+                        "mod.Pump._lock", threading.Lock())
+                    self._done = False
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self._done = True
+
+                def stop(self):
+                    with self._lock:
+                        self._done = True
+            """)
+        assert [f for f in fs if f.rule == "cross-thread-state"] == []
+
+
+# ---------------------------------------------------------------------------
+# --witness: runtime lock-witness join
+# ---------------------------------------------------------------------------
+
+class TestWitnessJoin:
+    def _witness_dir(self, tmp_path, doc):
+        d = tmp_path / "telemetry"
+        d.mkdir(exist_ok=True)
+        (d / "threadsan_host0_pid1.json").write_text(json.dumps(doc))
+        return str(d)
+
+    def _doc(self, **over):
+        doc = {"host": 0, "pid": 1, "updated": 1.0, "armed": True,
+               "locks": {}, "edges": [], "reports": []}
+        doc.update(over)
+        return doc
+
+    def test_deadlock_report_fails_threads_gate(self, tmp_path):
+        d = self._witness_dir(tmp_path, self._doc(
+            reports=[{"kind": "potential_deadlock",
+                      "cycle": ["a.L", "b.L", "a.L"],
+                      "locks": ["a.L", "b.L"], "stacks": {}}],
+            locks={"a.L": {"acquires": 9, "contended": 3,
+                           "wait_total": 0.5, "wait_max": 0.3,
+                           "hold_total": 0.1, "hold_max": 0.05}}))
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = _run_cli([str(clean), "--witness", d, "--env-doc", str(doc),
+                      "--baseline", str(tmp_path / "bl.json")])
+        assert r.returncode == 1, r.stdout + r.stderr
+        lines = r.stdout.strip().splitlines()
+        gate = json.loads(lines[-1])
+        assert gate["metric"] == "mxanalyze_threads_gate"
+        assert gate["status"] == "fail" and gate["reports"] == 1
+        # the failure detail names the worst contended lock
+        assert "a.L" in gate["detail"]
+        assert "potential_deadlock" in r.stdout
+
+    def test_runtime_inversion_without_report_fails(self, tmp_path):
+        d = self._witness_dir(tmp_path, self._doc(
+            edges=[{"outer": "a.L", "inner": "b.L", "count": 2,
+                    "site": "x.py:1 (f)"},
+                   {"outer": "b.L", "inner": "a.L", "count": 1,
+                    "site": "y.py:2 (g)"}]))
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = _run_cli([str(clean), "--witness", d, "--env-doc", str(doc),
+                      "--baseline", str(tmp_path / "bl.json")])
+        assert r.returncode == 1, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["inversions"] == 1
+        assert "witness inversion" in r.stdout
+
+    def test_clean_witness_passes(self, tmp_path):
+        d = self._witness_dir(tmp_path, self._doc(
+            edges=[{"outer": "a.L", "inner": "b.L", "count": 5,
+                    "site": "x.py:1 (f)"}],
+            locks={"a.L": {"acquires": 5, "contended": 0,
+                           "wait_total": 0.0, "wait_max": 0.0,
+                           "hold_total": 0.0, "hold_max": 0.0}}))
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = _run_cli([str(clean), "--witness", d, "--env-doc", str(doc),
+                      "--baseline", str(tmp_path / "bl.json")])
+        assert r.returncode == 0, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert gate["metric"] == "mxanalyze_threads_gate"
+        assert gate["status"] == "pass"
+
+    def test_empty_dir_passes_with_note(self, tmp_path):
+        d = tmp_path / "empty"
+        d.mkdir()
+        doc = tmp_path / "env.md"
+        doc.write_text("")
+        clean = tmp_path / "clean.py"
+        clean.write_text("X = 1\n")
+        r = _run_cli([str(clean), "--witness", str(d), "--env-doc",
+                      str(doc), "--baseline", str(tmp_path / "bl.json")])
+        assert r.returncode == 0, r.stdout + r.stderr
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        assert "no witness files" in gate["detail"]
+
+    def test_report_escalates_baselined_finding(self, tmp_path):
+        from tools.mxanalyze import witness as wit
+        src = tmp_path / "mxnet_tpu"
+        src.mkdir()
+        (src / "mod.py").write_text(textwrap.dedent("""
+            import threading
+            _state = {}
+
+            def worker():
+                _state["k"] = 1
+
+            def start():
+                threading.Thread(target=worker).start()
+                _state["k"] = 0
+            """))
+        fs = analyze_paths([str(src)], root=str(tmp_path),
+                           env_doc=str(tmp_path / "env.md"))
+        target = [f for f in fs if f.rule == "cross-thread-state"]
+        assert target, fs
+        esc = wit.escalate(fs, [{"kind": "potential_deadlock",
+                                 "cycle": ["a", "b", "a"]}])
+        assert esc and all(f.escalated == "witness:potential_deadlock"
+                           for f in esc)
+        assert all(f.severity == "error" for f in esc)
+
+    def test_freshest_doc_per_host_wins(self, tmp_path):
+        from tools.mxanalyze import witness as wit
+        d = tmp_path / "t"
+        d.mkdir()
+        (d / "threadsan_host0_pid1.json").write_text(json.dumps(
+            self._doc(updated=1.0,
+                      reports=[{"kind": "blocked_too_long",
+                                "lock": "stale.L"}])))
+        (d / "threadsan_host0_pid2.json").write_text(json.dumps(
+            self._doc(updated=2.0, pid=2)))
+        docs = wit.read(str(d))
+        assert len(docs) == 1 and docs[0]["pid"] == 2
+        assert wit.runtime_reports(docs) == []

@@ -1,0 +1,377 @@
+"""Thread sanitizer (TSan-lite) suite: the off path is zero-overhead
+passthrough, the armed path witnesses acquisition-order cycles with
+both stacks, wait/hold anatomy lands in telemetry histograms,
+held-across-dispatch and blocked-too-long hazards are filed once, and
+the witness round-trips through the per-host JSON transport into the
+``python -m mxnet_tpu.threadsan report`` CLI.
+
+Everything here is host-side threading — no device, no jax import
+needed beyond what mxnet_tpu pulls in.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from mxnet_tpu import telemetry, threadsan  # noqa: E402
+
+
+@pytest.fixture
+def armed():
+    """Arm the witness for locks registered inside the test, with clean
+    state on both sides. Locks other modules registered at import time
+    stay raw (arming is never retroactive)."""
+    threadsan.arm()
+    threadsan.reset()
+    yield
+    threadsan.reset()
+    threadsan.disarm()
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead contract (off)
+# ---------------------------------------------------------------------------
+
+class TestOffPath:
+    def test_register_returns_same_object(self):
+        threadsan.disarm()
+        lk = threading.Lock()
+        assert threadsan.register("t.off", lk) is lk
+        rl = threading.RLock()
+        assert threadsan.register("t.off_r", rl) is rl
+        cv = threading.Condition()
+        assert threadsan.register("t.off_c", cv) is cv
+        assert threadsan.held_locks() == []
+        assert threadsan.note_dispatch("t.site") is None
+
+    def test_module_locks_are_raw_when_off(self):
+        """With MXNET_THREADSAN unset, importing the project must leave
+        the registered module locks as plain threading primitives —
+        the exact objects their modules created."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        env.pop("MXNET_THREADSAN", None)
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from mxnet_tpu import telemetry, threadsan\n"
+             "assert not threadsan.ARMED\n"
+             "assert not isinstance(telemetry._lock,"
+             " threadsan.LockWitness), type(telemetry._lock)\n"
+             "print('RAW_OK')\n"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "RAW_OK" in r.stdout
+
+    def test_armed_boot_wraps_module_locks(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   MXNET_THREADSAN="1")
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from mxnet_tpu import telemetry, threadsan\n"
+             "assert threadsan.ARMED\n"
+             "assert isinstance(telemetry._lock, threadsan.LockWitness)\n"
+             "with telemetry._lock:\n"
+             "    pass\n"
+             "print('WRAPPED_OK')\n"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "WRAPPED_OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# deadlock witness
+# ---------------------------------------------------------------------------
+
+class TestDeadlockWitness:
+    def test_ab_ba_cycle_detected_with_both_stacks(self, armed):
+        A = threadsan.register("t.A", threading.Lock())
+        B = threadsan.register("t.B", threading.Lock())
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+
+        # serial execution: no actual deadlock, but the opposing order
+        # is exactly what the witness exists to catch
+        t1 = threading.Thread(target=ab, name="t-ab")
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba, name="t-ba")
+        t2.start()
+        t2.join()
+
+        snap = threadsan.snapshot()
+        reports = [r for r in snap["reports"]
+                   if r["kind"] == "potential_deadlock"]
+        assert len(reports) == 1, snap["reports"]
+        rep = reports[0]
+        assert sorted(rep["locks"]) == ["t.A", "t.B"]
+        # BOTH sides of the inversion carry a stack naming its thread
+        stacks = rep["stacks"]
+        assert "t.A -> t.B" in stacks and "t.B -> t.A" in stacks
+        assert stacks["t.A -> t.B"]["thread"] == "t-ab"
+        assert stacks["t.B -> t.A"]["thread"] == "t-ba"
+        assert any("ab" in fr for fr in stacks["t.A -> t.B"]["stack"])
+        assert any("ba" in fr for fr in stacks["t.B -> t.A"]["stack"])
+
+    def test_consistent_order_stays_clean(self, armed):
+        A = threadsan.register("t.A2", threading.Lock())
+        B = threadsan.register("t.B2", threading.Lock())
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        snap = threadsan.snapshot()
+        assert snap["reports"] == []
+        assert any(e["outer"] == "t.A2" and e["inner"] == "t.B2"
+                   and e["count"] == 3 for e in snap["edges"])
+
+    def test_rlock_reentry_records_no_self_edge(self, armed):
+        R = threadsan.register("t.R", threading.RLock())
+        with R:
+            with R:
+                assert threadsan.held_locks() == ["t.R"]
+        snap = threadsan.snapshot()
+        assert snap["reports"] == []
+        assert snap["edges"] == []
+        assert snap["locks"]["t.R"]["acquires"] == 1
+
+
+# ---------------------------------------------------------------------------
+# wait/hold anatomy
+# ---------------------------------------------------------------------------
+
+class TestWaitHoldAnatomy:
+    def test_contended_acquire_lands_in_stats_and_histograms(self, armed):
+        L = threadsan.register("t.C", threading.Lock())
+        wait_h = telemetry.histogram("lock_wait_seconds", lock="t.C")
+        hold_h = telemetry.histogram("lock_hold_seconds", lock="t.C")
+        wait_n0, hold_n0 = wait_h.count, hold_h.count
+        cont0 = telemetry.counter("lock_contention_total",
+                                  lock="t.C").value
+        entered = threading.Event()
+
+        def holder():
+            with L:
+                entered.set()
+                time.sleep(0.2)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        entered.wait(5)
+        with L:   # must contend against the 0.2s hold
+            pass
+        t.join()
+
+        st = threadsan.snapshot()["locks"]["t.C"]
+        assert st["acquires"] == 2
+        assert st["contended"] >= 1
+        assert st["wait_total"] >= 0.1
+        assert st["wait_max"] <= st["wait_total"] + 1e-9
+        assert st["hold_total"] >= 0.2
+        assert wait_h.count >= wait_n0 + 2
+        assert hold_h.count >= hold_n0 + 2
+        assert telemetry.counter("lock_contention_total",
+                                 lock="t.C").value >= cont0 + 1
+
+    def test_condition_wait_brackets_hold(self, armed):
+        cv = threadsan.register("t.CV", threading.Condition())
+        state = {"ready": False}
+
+        def waiter():
+            with cv:
+                while not state["ready"]:
+                    cv.wait(5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            state["ready"] = True
+            cv.notify_all()
+        t.join(5)
+        assert not t.is_alive()
+        snap = threadsan.snapshot()
+        assert [r for r in snap["reports"]
+                if r["kind"] == "potential_deadlock"] == []
+        # the waiter's wait() must not read as contention: the witness
+        # answers the Condition's _is_owned probe instead of letting it
+        # speculatively acquire
+        st = snap["locks"]["t.CV"]
+        assert st["acquires"] >= 3   # waiter enter + rewake + notifier
+
+
+# ---------------------------------------------------------------------------
+# held-across-dispatch + blocked-too-long
+# ---------------------------------------------------------------------------
+
+class TestHazards:
+    def test_held_across_dispatch_reported_once(self, armed):
+        L = threadsan.register("t.D", threading.Lock())
+        with L:
+            rep = threadsan.note_dispatch("test.site")
+            assert rep is not None
+            assert rep["locks"] == ["t.D"]
+            assert rep["dispatch_kind"] == "dispatch"
+            # same site + same lock set: filed once
+            assert threadsan.note_dispatch("test.site") is None
+        assert threadsan.note_dispatch("test.site2") is None  # not held
+        reports = [r for r in threadsan.snapshot()["reports"]
+                   if r["kind"] == "held_across_dispatch"]
+        assert len(reports) == 1
+        assert reports[0]["site"] == "test.site"
+
+    def test_dispatch_ok_lock_is_exempt(self, armed):
+        """A lock registered dispatch_ok=True (e.g. the compile lock,
+        which serializes work that dispatches by design) files no
+        held-across-dispatch report — but still records edges/stats."""
+        OK = threadsan.register("t.OK", threading.Lock(),
+                                dispatch_ok=True)
+        L = threadsan.register("t.NotOK", threading.Lock())
+        with OK:
+            assert threadsan.note_dispatch("exempt.site") is None
+            with L:
+                rep = threadsan.note_dispatch("mixed.site")
+                assert rep is not None
+                # only the non-exempt lock is named
+                assert rep["locks"] == ["t.NotOK"]
+        assert threadsan.snapshot()["locks"]["t.OK"]["acquires"] == 1
+
+    def test_blocked_too_long_files_report(self, armed, monkeypatch):
+        monkeypatch.setenv("MXNET_THREADSAN_BLOCK_SECONDS", "0.1")
+        L = threadsan.register("t.S", threading.Lock())
+        entered = threading.Event()
+
+        def holder():
+            with L:
+                entered.set()
+                time.sleep(0.35)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        entered.wait(5)
+        with L:
+            pass
+        t.join()
+        reports = [r for r in threadsan.snapshot()["reports"]
+                   if r["kind"] == "blocked_too_long"]
+        assert len(reports) == 1, threadsan.snapshot()["reports"]
+        assert reports[0]["lock"] == "t.S"
+        assert reports[0]["waited_seconds"] >= 0.1
+
+
+# ---------------------------------------------------------------------------
+# witness transport + report CLI
+# ---------------------------------------------------------------------------
+
+class TestWitnessRoundTrip:
+    def _populate_hazard(self):
+        A = threadsan.register("t.WA", threading.Lock())
+        B = threadsan.register("t.WB", threading.Lock())
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+    def test_write_load_roundtrip(self, armed, tmp_path):
+        self._populate_hazard()
+        path = threadsan.write_witness(dir=str(tmp_path))
+        assert path and os.path.basename(path).startswith(
+            "threadsan_host")
+        docs = threadsan.load_witness(str(tmp_path))
+        assert len(docs) == 1
+        doc = docs[0]
+        assert doc["armed"] is True
+        assert any(r["kind"] == "potential_deadlock"
+                   for r in doc["reports"])
+        assert {(e["outer"], e["inner"]) for e in doc["edges"]} == \
+            {("t.WA", "t.WB"), ("t.WB", "t.WA")}
+        # single-file load too
+        assert threadsan.load_witness(path)[0]["pid"] == doc["pid"]
+
+    def test_report_cli_flags_hazard(self, armed, tmp_path):
+        self._populate_hazard()
+        threadsan.write_witness(dir=str(tmp_path))
+        r = subprocess.run(
+            [sys.executable, "-m", "mxnet_tpu.threadsan", "report",
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            timeout=120)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "potential_deadlock" in r.stdout
+        assert "t.WA -> t.WB" in r.stdout
+        assert "t.WB -> t.WA" in r.stdout
+        assert "verdict:" in r.stdout
+
+    def test_report_cli_clean_and_empty(self, armed, tmp_path):
+        L = threadsan.register("t.Clean", threading.Lock())
+        with L:
+            pass
+        threadsan.write_witness(dir=str(tmp_path))
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "mxnet_tpu.threadsan", "report",
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "clean" in r.stdout
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        r = subprocess.run(
+            [sys.executable, "-m", "mxnet_tpu.threadsan", "report",
+             str(empty)],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=120)
+        assert r.returncode == 2, r.stdout + r.stderr
+
+    def test_threadsan_dir_overrides_telemetry_dir(self, armed, tmp_path,
+                                                   monkeypatch):
+        """MXNET_THREADSAN_DIR is a witness-only destination: it wins
+        over the telemetry dir, so a harness can collect witnesses in a
+        scratch dir while tests keep owning MXNET_TELEMETRY_DIR."""
+        wit = tmp_path / "wit"
+        tel = tmp_path / "tel"
+        wit.mkdir()
+        tel.mkdir()
+        monkeypatch.setenv("MXNET_TELEMETRY_DIR", str(tel))
+        monkeypatch.setenv("MXNET_THREADSAN_DIR", str(wit))
+        with threadsan.register("t.Dir", threading.Lock()):
+            pass
+        path = threadsan.write_witness()
+        assert path and os.path.dirname(path) == str(wit)
+        assert os.listdir(str(tel)) == []
+        assert threadsan.load_witness(str(wit))
+
+    def test_snapshot_is_json_serializable(self, armed):
+        self._populate_hazard()
+        with threadsan.register("t.J", threading.Lock()):
+            threadsan.note_dispatch("json.site")
+        json.dumps(threadsan.snapshot())

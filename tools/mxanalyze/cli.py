@@ -1,5 +1,5 @@
 """CLI: ``python -m tools.mxanalyze [--strict] [--update-baseline]
-[--changed-only] [--profile DIR] [paths...]``.
+[--changed-only] [--profile DIR] [--witness DIR] [paths...]``.
 
 Exit codes follow ``tools/bench_gate.py``: 0 = gate passes, 1 = gate
 fails, 2 = usage error; the last stdout line is a BENCH-style JSON
@@ -12,6 +12,11 @@ fast incremental gate. ``--profile <telemetry-dir>`` additionally joins
 the findings with stepprof/shardprof/runprof runtime verdicts: findings
 a verdict explains are escalated to error (baseline amnesty does not
 apply) and a second ``mxanalyze_perf_gate`` line is emitted.
+``--witness <telemetry-dir>`` does the same join against a live
+``MXNET_THREADSAN=1`` lock witness: runtime acquisition-order edges
+merge into the static inversion check, hazard reports escalate their
+explaining rules, and an ``mxanalyze_threads_gate`` line is emitted
+whose failure detail names the worst contended lock.
 """
 from __future__ import annotations
 
@@ -89,6 +94,12 @@ def main(argv=None):
                          "host snapshots: escalate findings matching "
                          "runtime verdicts and emit an "
                          "mxanalyze_perf_gate line")
+    ap.add_argument("--witness", default=None, metavar="DIR",
+                    help="telemetry dir (or one file) of threadsan "
+                         "lock-witness snapshots: merge runtime lock-"
+                         "order edges into the inversion check, "
+                         "escalate findings witness hazards confirm, "
+                         "and emit an mxanalyze_threads_gate line")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from this run and exit 0")
     ap.add_argument("--baseline", default=None,
@@ -163,8 +174,21 @@ def main(argv=None):
         verdicts = profiles.read_verdicts(args.profile)
         escalated = profiles.escalate(findings, verdicts)
 
+    # --witness: same placement as --profile — escalation must precede
+    # printing so witness-confirmed findings surface with their tag
+    wit_docs, wit_reports, wit_inversions, wit_escalated = [], [], [], []
+    if args.witness is not None:
+        from . import witness
+        wit_docs = witness.read(args.witness)
+        wit_reports = witness.runtime_reports(wit_docs)
+        wit_inversions = witness.merged_inversions(
+            witness.runtime_edges(wit_docs),
+            witness.static_edge_labels())
+        wit_escalated = witness.escalate(findings, wit_reports)
+
     shown = findings if args.all else sorted(
-        set(new) | set(escalated), key=lambda f: f.sort_key())
+        set(new) | set(escalated) | set(wit_escalated),
+        key=lambda f: f.sort_key())
     if args.format == "json":
         doc = {"findings": [f.to_dict() for f in shown],
                "new": len(new), "baselined": len(baselined),
@@ -172,12 +196,23 @@ def main(argv=None):
         if args.profile is not None:
             doc["verdicts"] = verdicts
             doc["escalated"] = len(escalated)
+        if args.witness is not None:
+            doc["witness_reports"] = wit_reports
+            doc["witness_inversions"] = wit_inversions
+            doc["witness_escalated"] = len(wit_escalated)
         print(json.dumps(doc, indent=1))
     else:
         for v in verdicts:
             print("runtime verdict [%s, %s]: %s%s"
                   % (v["verdict"], v["source"], v["file"],
                      " -- " + v["detail"] if v["detail"] else ""))
+        if args.witness is not None:
+            from . import witness
+            for rep in wit_reports:
+                print(witness.render_report(rep))
+            for inv in wit_inversions:
+                print("witness inversion: %s (%s)"
+                      % (inv["pair"], "; ".join(inv["sources"])))
         new_set = set(new)
         for f in shown:
             tag = "" if f in new_set else " [baselined]"
@@ -212,4 +247,36 @@ def main(argv=None):
                   verdicts=[v["verdict"] for v in verdicts],
                   escalated=len(escalated))
         failed = failed or perf_failed
+
+    if args.witness is not None:
+        from . import witness
+        threads_failed = bool(wit_reports or wit_inversions
+                              or wit_escalated)
+        worst_name, worst = witness.worst_contended(
+            witness.lock_stats(wit_docs))
+        if not wit_docs:
+            threads_detail = "no witness files under %s" % args.witness
+        elif threads_failed:
+            threads_detail = ("%d hazard report(s), %d inversion(s), "
+                              "%d escalated"
+                              % (len(wit_reports), len(wit_inversions),
+                                 len(wit_escalated)))
+            if worst_name:
+                threads_detail += (
+                    "; worst contended lock: %s (%.3fs waited over %d "
+                    "contended acquires)"
+                    % (worst_name, worst["wait_total"],
+                       worst["contended"]))
+        else:
+            threads_detail = ("witness clean: %d lock(s), %d edge(s), "
+                              "no hazards"
+                              % (len(witness.lock_stats(wit_docs)),
+                                 len(witness.runtime_edges(wit_docs))))
+        gate_line("fail" if threads_failed else "pass", threads_detail,
+                  metric="mxanalyze_threads_gate",
+                  reports=len(wit_reports),
+                  inversions=len(wit_inversions),
+                  escalated=len(wit_escalated),
+                  worst_contended=worst_name)
+        failed = failed or threads_failed
     return 1 if failed else 0

@@ -38,6 +38,10 @@ RULES = {
     "sharding-reachability": "sharding specs with no in-program "
                              "constraint path, and parallel modules "
                              "unreachable from any frontend",
+    "cross-thread-state": "state written from >=2 thread entry roots "
+                          "with at least one write outside any lock; "
+                          "bare Condition.wait() without a while-"
+                          "predicate loop",
     "bad-suppression": "malformed mxanalyze suppression comment",
     "parse-error": "file could not be parsed",
 }
@@ -52,6 +56,7 @@ SEVERITY = {
     "dispatch-amplification": "warning",
     "donation-hazard": "error",
     "sharding-reachability": "warning",
+    "cross-thread-state": "warning",
     "bad-suppression": "warning",
     "parse-error": "error",
 }

@@ -1,10 +1,10 @@
 """Pass registry: one module per rule, each exporting ``PASS``."""
 from . import (dispatch, donation, envvars, hostsync, jit_purity, locks,
-               retrace, sharding, swallowed)
+               retrace, sharding, swallowed, threads)
 
 #: run order is reporting order for ties; findings are re-sorted anyway.
 ALL_PASSES = [jit_purity.PASS, retrace.PASS, locks.PASS, swallowed.PASS,
               envvars.PASS, hostsync.PASS, dispatch.PASS, donation.PASS,
-              sharding.PASS]
+              sharding.PASS, threads.PASS]
 
 __all__ = ["ALL_PASSES"]

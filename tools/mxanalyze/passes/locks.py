@@ -39,8 +39,16 @@ _EXEMPT_FNS = {"__init__", "__new__", "__init_subclass__"}
 def _lock_ctor_kind(call, aliases):
     """'Lock'/'RLock'/... when ``call`` constructs a threading primitive
     (``threading.Lock()``, an aliased module, or a bare ``Lock()`` from
-    ``from threading import Lock``), else None."""
+    ``from threading import Lock``), else None.
+
+    Sees through ``threadsan.register("label", threading.Lock())`` —
+    the witness wrapper hands back either the original lock (off) or a
+    proxy with identical semantics (armed), so the wrapped ctor is
+    still the lock's identity for discipline purposes."""
     parts = dotted_parts(call.func) if isinstance(call, ast.Call) else []
+    if parts and parts[-1] == "register" and "threadsan" in parts[:-1] \
+            and isinstance(call, ast.Call) and len(call.args) == 2:
+        return _lock_ctor_kind(call.args[1], aliases)
     if not parts or parts[-1] not in _LOCK_CTORS:
         return None
     if len(parts) >= 2:

@@ -1,0 +1,325 @@
+"""cross-thread-state: state shared between thread roots without a lock.
+
+The thread-rooted upgrade the lexical lock table can't express: the
+``lock-discipline`` pass flags *mixed-guard* writes (some under a lock,
+some not), but a symbol written consistently with NO lock from two
+different threads never mixes and sails through. This pass first
+computes **thread entry roots** per module:
+
+- targets of ``threading.Thread(target=...)`` (module functions and
+  ``self.method`` bound targets),
+- ``run()`` of ``threading.Thread`` subclasses,
+- everything else seeds from public entry points as the ``main`` root,
+
+then propagates roots through the module's direct call graph (a helper
+called only from a worker loop runs on the worker root; one called from
+both runs on both). A module-global or ``self.attr`` written from >= 2
+distinct roots where at least one write happens outside any recognized
+``with <lock>`` is flagged at the unguarded site(s).
+
+Construction is exempt (``__init__``/``__new__`` — single-threaded by
+convention), as is module top level (import lock).
+
+Also in this pass (low severity, same rule): a bare ``Condition.wait()``
+outside any ``while`` loop — the predicate must be re-checked on wakeup
+(spurious wakeups, stolen wakeups), so ``wait()`` belongs inside
+``while not predicate:`` or should be ``wait_for(predicate)``.
+
+Runtime join: ``mxanalyze --witness <dir>`` (tools/mxanalyze/witness.py)
+merges the acquisition-order edges a live ``MXNET_THREADSAN=1`` run
+recorded into the static inversion check and escalates findings of this
+rule that a witness hazard report confirms.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding
+from .common import dotted_parts, import_aliases, module_globals
+from .locks import (_EXEMPT_FNS, _LockTable, _symbol_of, _write_targets)
+
+RULE = "cross-thread-state"
+
+
+def _is_thread_ctor(call, aliases):
+    """True when ``call`` constructs a ``threading.Thread``."""
+    parts = dotted_parts(call.func)
+    if not parts or parts[-1] != "Thread":
+        return False
+    if len(parts) == 1:
+        return aliases.get("Thread") == "threading.Thread"
+    base = parts[-2]
+    return base == "threading" or aliases.get(base) == "threading"
+
+
+def _is_thread_base(base, aliases):
+    parts = dotted_parts(base)
+    if parts == ["Thread"]:
+        return aliases.get("Thread") == "threading.Thread"
+    return parts[-2:] == ["threading", "Thread"]
+
+
+def _root_label(key):
+    return key[1] if not key[0] else "%s.%s" % key
+
+
+class _ModuleIndex:
+    """Function defs, call edges, and thread roots of one module.
+
+    Function keys are ``(class_name_or_empty, fn_name)``; the call graph
+    only follows edges it can resolve lexically (bare names to module
+    functions, ``self.m`` to methods of the same class) — a deliberate
+    under-approximation that keeps root attribution sound for the
+    worker-loop idiom this codebase uses."""
+
+    def __init__(self, mod, aliases):
+        self.mod = mod
+        self.aliases = aliases
+        self.fns = {}        # (cls, name) -> FunctionDef
+        self.callees = {}    # (cls, name) -> set of callee keys
+        self.thread_roots = {}   # fn key -> root label
+        self._collect()
+
+    def _collect(self):
+        tree = self.mod.tree
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fns[("", node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                is_thread_cls = any(_is_thread_base(b, self.aliases)
+                                    for b in node.bases)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        key = (node.name, sub.name)
+                        self.fns[key] = sub
+                        if is_thread_cls and sub.name == "run":
+                            self.thread_roots[key] = _root_label(key)
+        # call edges + Thread(target=...) roots, attributed to the
+        # enclosing function (or "main" for module/class top level)
+        for key, fn in self.fns.items():
+            self.callees[key] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                self._note_call(key, node)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _is_thread_ctor(node, self.aliases):
+                self._note_thread_target(node)
+
+    def _note_call(self, caller, call):
+        if isinstance(call.func, ast.Name):
+            key = ("", call.func.id)
+            if key in self.fns:
+                self.callees[caller].add(key)
+        elif isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self" and caller[0]:
+            key = (caller[0], call.func.attr)
+            if key in self.fns:
+                self.callees[caller].add(key)
+
+    def _enclosing_class(self, target):
+        """Class name owning a ``self.X`` thread target: the class that
+        defines method ``X`` (unique in this module, else unresolved)."""
+        owners = [cls for (cls, name) in self.fns
+                  if cls and name == target]
+        return owners[0] if len(owners) == 1 else None
+
+    def _note_thread_target(self, call):
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Name):
+                key = ("", v.id)
+                if key in self.fns:
+                    self.thread_roots[key] = _root_label(key)
+            elif isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                cls = self._enclosing_class(v.attr)
+                if cls is not None:
+                    key = (cls, v.attr)
+                    self.thread_roots[key] = _root_label(key)
+
+    def roots(self):
+        """fn key -> sorted tuple of thread-root labels ("main" and/or
+        worker roots), via propagation over the call graph."""
+        labels = {key: set() for key in self.fns}
+        # worker roots flow down from each spawn target
+        for root_key, label in self.thread_roots.items():
+            stack = [root_key]
+            seen = set()
+            while stack:
+                key = stack.pop()
+                if key in seen:
+                    continue
+                seen.add(key)
+                labels[key].add(label)
+                stack.extend(self.callees.get(key, ()))
+        # "main" flows from every entry point that is NOT a thread
+        # target: public API with no intra-module caller (plus anything
+        # those reach)
+        callers = {}
+        for caller, callees in self.callees.items():
+            for c in callees:
+                callers.setdefault(c, set()).add(caller)
+        main_seeds = [key for key in self.fns
+                      if key not in self.thread_roots
+                      and not callers.get(key)]
+        stack = list(main_seeds)
+        seen = set()
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            labels[key].add("main")
+            stack.extend(self.callees.get(key, ()))
+        return {key: tuple(sorted(v)) for key, v in labels.items()}
+
+
+class _AccessWalker(ast.NodeVisitor):
+    """Walk one function with a with-lock stack and a while-loop depth,
+    collecting writes (symbol, locked?) and bare Condition waits."""
+
+    def __init__(self, pass_, mod, aliases, class_name, fn, fn_roots):
+        self.p = pass_
+        self.mod = mod
+        self.aliases = aliases
+        self.class_name = class_name
+        self.fn = fn
+        self.fn_roots = fn_roots
+        self.stack = []
+        self.while_depth = 0
+
+    def visit_With(self, node):
+        acquired = 0
+        for item in node.items:
+            lid = self.p.table.resolve(self.mod, self.aliases,
+                                       self.class_name,
+                                       item.context_expr)
+            if lid is not None:
+                self.stack.append(lid)
+                acquired += 1
+        self.generic_visit(node)
+        for _ in range(acquired):
+            self.stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_While(self, node):
+        self.while_depth += 1
+        self.generic_visit(node)
+        self.while_depth -= 1
+
+    def _check_bare_wait(self, node):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"):
+            return
+        lid = self.p.table.resolve(self.mod, self.aliases,
+                                   self.class_name, node.func.value)
+        if lid is None or self.p.table.kinds.get(lid) != "Condition":
+            return
+        if self.while_depth == 0:
+            self.p.findings.append(Finding(
+                RULE, self.mod.relpath, node.lineno, node.col_offset,
+                "bare Condition.wait() outside a while loop — the "
+                "predicate is not re-checked on wakeup (spurious/stolen "
+                "wakeups)",
+                hint="wrap in `while not predicate: cond.wait()` or use "
+                     "cond.wait_for(predicate)"))
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return   # nested defs do not run under this lock stack
+        self._check_bare_wait(node)
+        for tgt in _write_targets(node):
+            sym = _symbol_of(tgt, self.p.globals_by_mod.get(
+                self.mod.relpath, set()), self.class_name)
+            if sym is not None and self.fn.name not in _EXEMPT_FNS:
+                key = (self.mod.relpath,) + sym
+                self.p.writes.setdefault(key, []).append(
+                    (self.mod.relpath, node.lineno, node.col_offset,
+                     tuple(self.stack), self.fn_roots))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            self.visit(child)
+
+
+class Pass:
+    rule = RULE
+
+    def run(self, project):
+        self.table = _LockTable()
+        self.findings = []
+        self.writes = {}   # symbol key -> [(path, line, col, locks, roots)]
+        self.globals_by_mod = {}
+        for mod in project.modules:
+            self.table.collect(mod)
+            if mod.tree is not None:
+                self.globals_by_mod[mod.relpath] = \
+                    module_globals(mod.tree)
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            self._walk_module(mod)
+        self._report()
+        return self.findings
+
+    def _walk_module(self, mod):
+        aliases = import_aliases(mod.tree)
+        index = _ModuleIndex(mod, aliases)
+        if not index.thread_roots:
+            # a module that never spawns a thread has ONE root: nothing
+            # here can be cross-thread (waits are still worth checking
+            # when a Condition exists, but with no second thread there
+            # is no waker — skip entirely)
+            return
+        roots = index.roots()
+        for key, fn in index.fns.items():
+            cls = key[0] or None
+            w = _AccessWalker(self, mod, aliases, cls, fn,
+                              roots.get(key, ("main",)))
+            for stmt in fn.body:
+                w.visit(stmt)
+            # nested defs run with their own empty lock stack but the
+            # same thread roots as their definer (closures handed to
+            # callbacks — conservative)
+            for sub in ast.walk(fn):
+                if sub is not fn and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    wn = _AccessWalker(self, mod, aliases, cls, sub,
+                                       roots.get(key, ("main",)))
+                    for stmt in sub.body:
+                        wn.visit(stmt)
+
+    def _report(self):
+        for key, sites in sorted(self.writes.items()):
+            all_roots = sorted({r for s in sites for r in s[4]})
+            if len(all_roots) < 2:
+                continue
+            unlocked = [s for s in sites if not s[3]]
+            if not unlocked:
+                continue
+            sym = key[1:]
+            label = ("%s.%s" % (sym[1], sym[2]) if sym[0] == "attr"
+                     else sym[1])
+            for path, line, col, _, _ in unlocked:
+                self.findings.append(Finding(
+                    RULE, path, line, col,
+                    "'%s' is written from multiple thread roots (%s) "
+                    "and this write is outside any lock"
+                    % (label, ", ".join(all_roots)),
+                    hint="guard the write with the owning lock, or "
+                         "document the ordering contract (queue/Event "
+                         "handoff, single-writer) and allow() it"))
+
+
+PASS = Pass()

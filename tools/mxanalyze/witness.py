@@ -1,0 +1,210 @@
+"""``--witness``: join static findings with a runtime lock witness.
+
+Reads the ``threadsan_host<h>_pid<p>.json`` snapshots a live
+``MXNET_THREADSAN=1`` run drops into the telemetry dir (same
+``write_host_json`` transport as the profiler snapshots; parsed here
+with ``json`` only — the analyzer never imports the analyzed code):
+
+- **edges**: acquisition-order edges actually witnessed at runtime.
+  Merged into the static inversion check: a runtime ``A -> B`` paired
+  with a static or runtime ``B -> A`` is an inversion even when one
+  side was invisible to the lexical walker (callback indirection,
+  locks passed through queues).
+- **reports**: hazards the witness filed (``potential_deadlock``,
+  ``held_across_dispatch``, ``blocked_too_long``). Each kind ESCALATES
+  the static findings that explain it — a live deadlock witness means
+  the baseline's amnesty for ``lock-discipline`` /
+  ``cross-thread-state`` findings no longer applies.
+- **stats**: per-lock wait/hold aggregates; the gate's failure detail
+  names the worst contended lock so the log line alone says where to
+  look.
+
+The CLI emits a BENCH-style ``mxanalyze_threads_gate`` line that fails
+on any hazard report, merged inversion, or escalation.
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+
+from .passes import locks
+
+#: witness report kind -> static rules it escalates (all under
+#: mxnet_tpu/ — the witness only ever wraps project locks)
+ESCALATIONS = {
+    "potential_deadlock": ("lock-discipline", "cross-thread-state"),
+    "held_across_dispatch": ("cross-thread-state", "host-sync-hazard"),
+    "blocked_too_long": ("lock-discipline",),
+}
+_PREFIX = "mxnet_tpu/"
+
+
+def witness_files(dirpath):
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return []
+    return [os.path.join(dirpath, fn) for fn in names
+            if fnmatch.fnmatch(fn, "threadsan_host*.json")]
+
+
+def has_witness(dirpath):
+    return bool(witness_files(dirpath))
+
+
+def read(path_or_dir):
+    """Witness docs: one file -> ``[doc]``; a dir -> the freshest doc
+    per host (same freshest-wins rule as the telemetry merge, mirrored
+    not imported)."""
+    if os.path.isfile(path_or_dir):
+        doc = _read_json(path_or_dir)
+        return [doc] if isinstance(doc, dict) else []
+    by_host = {}
+    for path in witness_files(path_or_dir):
+        doc = _read_json(path)
+        if not isinstance(doc, dict):
+            continue
+        host = doc.get("host", 0)
+        kept = by_host.get(host)
+        if kept is None or doc.get("updated", 0) > kept.get("updated", 0):
+            by_host[host] = doc
+    return [by_host[h] for h in sorted(by_host)]
+
+
+def _read_json(path):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def runtime_edges(docs):
+    """(outer, inner) -> summed witnessed count across hosts."""
+    out = {}
+    for doc in docs:
+        for e in doc.get("edges") or []:
+            key = (e.get("outer"), e.get("inner"))
+            if None in key:
+                continue
+            out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def runtime_reports(docs):
+    """Hazard reports across hosts, deduplicated by (kind, cycle/lock)
+    so N hosts hitting the same hazard read as one verdict."""
+    out, seen = [], set()
+    for doc in docs:
+        for rep in doc.get("reports") or []:
+            kind = rep.get("kind")
+            key = (kind, json.dumps(rep.get("cycle")
+                                    or rep.get("lock")
+                                    or rep.get("locks"), sort_keys=True))
+            if kind is None or key in seen:
+                continue
+            seen.add(key)
+            out.append(rep)
+    return out
+
+
+def lock_stats(docs):
+    """name -> merged wait/hold aggregates (sums summed, maxes maxed)."""
+    out = {}
+    for doc in docs:
+        for name, st in (doc.get("locks") or {}).items():
+            agg = out.setdefault(name, {
+                "acquires": 0, "contended": 0, "wait_total": 0.0,
+                "wait_max": 0.0, "hold_total": 0.0, "hold_max": 0.0})
+            for k in ("acquires", "contended"):
+                agg[k] += int(st.get(k, 0))
+            for k in ("wait_total", "hold_total"):
+                agg[k] += float(st.get(k, 0.0))
+            for k in ("wait_max", "hold_max"):
+                agg[k] = max(agg[k], float(st.get(k, 0.0)))
+    return out
+
+
+def worst_contended(stats):
+    """(name, stats) of the contended lock threads waited on longest;
+    (None, None) when no lock ever contended."""
+    ranked = sorted(
+        ((name, st) for name, st in stats.items() if st["contended"]),
+        key=lambda kv: kv[1]["wait_total"])
+    return ranked[-1] if ranked else (None, None)
+
+
+def static_edge_labels():
+    """The lock-order edges the last ``locks`` pass run recorded, as
+    normalized ``stem.Class.attr`` / ``stem.name`` labels matching the
+    witness's registration names (``.self.`` collapsed)."""
+    out = {}
+    for (a, b), sites in getattr(locks.PASS, "edges", {}).items():
+        key = (_norm(locks._lock_label(a)), _norm(locks._lock_label(b)))
+        out.setdefault(key, []).extend(sites)
+    return out
+
+
+def _norm(label):
+    return label.replace(".self.", ".")
+
+
+def merged_inversions(rt_edges, st_edges):
+    """Inversions only the runtime witness can prove: a witnessed
+    ``A -> B`` whose reverse edge exists at runtime or statically.
+    Pure static-static inversions are already lock-discipline findings.
+    Returns ``[{"pair", "sources"}]`` sorted, each pair once."""
+    out, seen = [], set()
+    for (a, b) in sorted(rt_edges):
+        pair = tuple(sorted((a, b)))
+        if pair in seen:
+            continue
+        sources = []
+        if (b, a) in rt_edges:
+            sources.append("runtime both ways")
+        if (b, a) in st_edges:
+            sources.append("static %s -> %s at %s:%d"
+                           % ((b, a) + st_edges[(b, a)][0]))
+        if sources:
+            seen.add(pair)
+            out.append({"pair": "%s -> %s" % (a, b),
+                        "sources": sources})
+    return out
+
+
+def escalate(findings, reports):
+    """Mark every static finding a witness hazard explains as escalated
+    (severity becomes error; baseline amnesty overridden). Run over the
+    FULL finding list, baselined included."""
+    escalated = []
+    for rep in reports:
+        rules = ESCALATIONS.get(rep.get("kind"))
+        if rules is None:
+            continue
+        for f in findings:
+            if f.escalated or f.rule not in rules:
+                continue
+            if f.path.startswith(_PREFIX):
+                f.escalated = "witness:%s" % rep["kind"]
+                escalated.append(f)
+    escalated.sort(key=lambda f: f.sort_key())
+    return escalated
+
+
+def render_report(rep):
+    """One human line per hazard report (stacks summarized)."""
+    kind = rep.get("kind", "?")
+    if kind == "potential_deadlock":
+        body = " -> ".join(rep.get("cycle") or [])
+    elif kind == "held_across_dispatch":
+        body = "%s held entering %s" % (
+            "/".join(rep.get("locks") or []), rep.get("site", "?"))
+    elif kind == "blocked_too_long":
+        body = "%s blocked %.1fs" % (rep.get("lock", "?"),
+                                     rep.get("waited_seconds", 0.0))
+    else:
+        body = json.dumps({k: v for k, v in rep.items()
+                           if k not in ("kind", "stacks", "time")},
+                          sort_keys=True)
+    return "witness hazard [%s]: %s" % (kind, body)

@@ -20,9 +20,19 @@ Runs the two gates that share exit-code conventions (0 = pass,
   ``bench_gate_states`` state-seconds delta line on regression)
   whenever the run carries a ``goodput_fraction``.
 
+``--threads`` additionally runs the launched concurrency tests under
+``MXNET_THREADSAN=1`` with a scratch witness dir
+(``MXNET_THREADSAN_DIR``), then feeds the lock witness those runs
+wrote back into ``mxanalyze --witness`` — runtime
+acquisition-order edges join the static inversion check and any hazard
+report (potential deadlock, lock held across dispatch, blocked too
+long) fails the ``mxanalyze_threads_gate`` line, naming the worst
+contended lock.
+
 Usage:
     python tools/repo_gate.py                     # analysis only
     python tools/repo_gate.py --bench run.jsonl   # analysis + perf
+    python tools/repo_gate.py --threads           # analysis + witness
     python bench.py | python tools/repo_gate.py --bench -
 
 Exit status: 0 when every gate passed, 1 when any failed. Every gate
@@ -35,6 +45,53 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: tests that actually spin up threads against the registered locks —
+#: the witness only learns from code that runs, so the --threads gate
+#: drives the serving engine, prefetch iterators, ps_async, and the
+#: sanitizer's own fixtures rather than the whole suite
+THREAD_TESTS = ["tests/test_threadsan.py", "tests/test_serving.py",
+                "tests/test_io_iterators.py", "tests/test_dist_async.py"]
+
+
+def run_thread_witness(paths=None, tests=None, timeout=600):
+    """Run the concurrency tests armed (``MXNET_THREADSAN=1``) with a
+    scratch witness dir, then join the witness they wrote back into
+    the static analysis via ``mxanalyze --witness``. The scratch dir
+    rides ``MXNET_THREADSAN_DIR`` (witness-only), NOT
+    ``MXNET_TELEMETRY_DIR`` — several of these tests monkeypatch the
+    telemetry dir themselves and a gate-level preset would shadow it.
+    Returns the gate rc (test failures fail the gate too — an
+    unexercised witness must not read as clean)."""
+    import subprocess
+    import tempfile
+    from tools.mxanalyze.cli import gate_line
+    from tools.mxanalyze.cli import main as mxanalyze_main
+
+    tests = [t for t in (tests or THREAD_TESTS)
+             if os.path.exists(os.path.join(REPO, t))]
+    if not tests:
+        gate_line("fail", "no concurrency tests found to arm",
+                  metric="mxanalyze_threads_gate")
+        return 1
+    with tempfile.TemporaryDirectory(prefix="threadsan_gate_") as tmp:
+        env = dict(os.environ, MXNET_THREADSAN="1",
+                   MXNET_THREADSAN_DIR=tmp, JAX_PLATFORMS="cpu")
+        env.pop("MXNET_TELEMETRY_DIR", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-x",
+             "-m", "not slow", "-p", "no:cacheprovider"] + tests,
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout)
+        if proc.returncode != 0:
+            tail = "\n".join(proc.stdout.splitlines()[-15:])
+            print(tail, file=sys.stderr)
+            gate_line("fail",
+                      "armed concurrency tests failed (rc %d)"
+                      % proc.returncode,
+                      metric="mxanalyze_threads_gate")
+            return 1
+        return mxanalyze_main(["--witness", tmp] + (paths or []))
 
 
 def main(argv=None):
@@ -49,6 +106,10 @@ def main(argv=None):
     ap.add_argument("--changed-only", action="store_true",
                     help="scope mxanalyze to files git reports changed "
                          "(fast incremental gate, same exit codes)")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the launched concurrency tests under "
+                         "MXNET_THREADSAN=1 and join the lock witness "
+                         "back via mxanalyze --witness")
     args = ap.parse_args(argv)
 
     sys.path.insert(0, REPO)
@@ -57,6 +118,9 @@ def main(argv=None):
     mx_args = ["--strict"] + (["--changed-only"] if args.changed_only
                               else []) + (args.paths or [])
     rc = mxanalyze_main(mx_args)
+
+    if args.threads:
+        rc = max(rc, run_thread_witness(paths=args.paths))
 
     if args.bench is not None:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
