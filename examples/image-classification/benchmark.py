@@ -202,7 +202,7 @@ def _bench_phase_breakdown(args, mod, batches, att_calls=2):
     BENCH history carries attribution)."""
     import json
     import numpy as np
-    from mxnet_tpu import runprof, stepprof, telemetry
+    from mxnet_tpu import memprof, runprof, stepprof, telemetry
 
     K = args.batches_per_dispatch
     stepprof.enable(sync_every=1)
@@ -234,16 +234,22 @@ def _bench_phase_breakdown(args, mod, batches, att_calls=2):
         fused=mod._fused_plan is not False,
         donated=bool(getattr(mod, "scan_donate_params", False)))
     run_snap = runprof.snapshot()
+    # memory anatomy: a forced sample over the steady-state window, so
+    # the TRAIN record carries the worst-device peak + scope waterfall
+    memprof.sample("bench", force=True)
     print(json.dumps({
         "metric": "train_phase_breakdown", "unit": "share",
         "phases": {k: round(v, 4) for k, v in shares.items()},
         "verdict": verdict, "hint": hint,
         "goodput_fraction": round(run_snap["goodput_fraction"], 4),
         "run_states": {k: round(v, 4)
-                       for k, v in run_snap["states"].items()}}),
+                       for k, v in run_snap["states"].items()},
+        "peak_hbm_bytes": memprof.peak_hbm_bytes(),
+        "memory_scopes": memprof.attribution()}),
         flush=True)
     stepprof.write_host_snapshot(force=True)  # telemetry dir, if armed
     runprof.write_host_snapshot(force=True)
+    memprof.write_host_snapshot(force=True)
 
 
 if __name__ == "__main__":

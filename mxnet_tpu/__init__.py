@@ -13,6 +13,7 @@ from .base import MXNetError, __version__
 from . import telemetry  # metrics/spans; inert unless MXNET_TELEMETRY_DIR
 from . import stepprof  # step-time anatomy; verbose layer needs MXNET_STEPPROF
 from . import runprof  # run anatomy: goodput/badput ledger + health sentinels
+from . import memprof  # memory anatomy: HBM timeline / leak sentinel / OOM forensics
 from . import chaos  # fault injection; inert unless armed (MXNET_CHAOS)
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import engine

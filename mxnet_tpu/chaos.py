@@ -58,6 +58,9 @@ SITES = {
     "serving.worker_death": "kill a serving replica worker thread at the "
                             "batch boundary — the in-flight batch must "
                             "fail cleanly and the worker respawn",
+    "memory.oom": "raise a synthetic RESOURCE_EXHAUSTED at CompiledProgram "
+                  "dispatch (VALUE = requested bytes, default 1 GiB) so "
+                  "memprof's OOM forensics are testable on CPU",
 }
 
 #: exit code used by an injected worker death (distinct from the elastic

@@ -255,6 +255,32 @@ def _count(name, site, help=""):
     telemetry.counter(name, help=help, site=site).inc()
 
 
+def _memprof_dispatch(site):
+    """Memory anatomy hook at dispatch: throttled HBM timeline sample
+    plus the ``memory.oom`` chaos poll (an injected error propagates
+    into the dispatch OOM handler below). Lazy import like the
+    runprof/shardprof hooks; only the import itself is guarded —
+    memprof swallows its own internals."""
+    try:
+        from . import memprof
+    except Exception as exc:
+        telemetry.swallowed("compiled.memprof", exc)
+        return
+    memprof.on_dispatch(site)
+
+
+def _memprof_oom(exc, site):
+    """The DeviceOOMError to raise in place of ``exc`` when memprof
+    recognizes a RESOURCE_EXHAUSTED (postmortem written as a side
+    effect), else None."""
+    try:
+        from . import memprof
+        return memprof.maybe_oom_error(exc, site=site)
+    except Exception as exc2:
+        telemetry.swallowed("compiled.memprof_oom", exc2)
+        return None
+
+
 def _flops_of(compiled):
     try:
         cost = compiled.cost_analysis()
@@ -398,10 +424,18 @@ class CompiledProgram:
         self.last_flops = entry.flops
         self.last_memory = entry.memory
         if entry.compiled is None:
-            with self._mesh_scope():
-                return self._fn(*args)
+            try:
+                _memprof_dispatch(self.site)
+                with self._mesh_scope():
+                    return self._fn(*args)
+            except Exception as exc:
+                oom = _memprof_oom(exc, self.site)
+                if oom is not None:
+                    raise oom from exc
+                raise
         call_args = [a for i, a in enumerate(args) if i not in self._static]
         try:
+            _memprof_dispatch(self.site)
             return entry.compiled(*call_args)
         except (TypeError, ValueError) as exc:
             # argument validation the signature key did not capture
@@ -414,6 +448,13 @@ class CompiledProgram:
             entry.compiled = None
             with self._mesh_scope():
                 return self._fn(*args)
+        except Exception as exc:
+            # OOM forensics: a RESOURCE_EXHAUSTED at dispatch re-raises
+            # enriched with the memprof verdict (postmortem on disk)
+            oom = _memprof_oom(exc, self.site)
+            if oom is not None:
+                raise oom from exc
+            raise
 
     def _compile_entry(self, key, args):
         with self._compile_lock:
@@ -449,8 +490,14 @@ class CompiledProgram:
                     with self._mesh_scope():
                         compiled = self._fn.lower(*args).compile()
                 except Exception as exc:
-                    # trace/compile errors must surface through the
-                    # plain call below, with jit's own diagnostics
+                    # a RESOURCE_EXHAUSTED at compile would just OOM
+                    # again (more confusingly) on the deferred-jit
+                    # path: surface it NOW with the memprof verdict
+                    oom = _memprof_oom(exc, self.site)
+                    if oom is not None:
+                        raise oom from exc
+                    # other trace/compile errors must surface through
+                    # the plain call below, with jit's own diagnostics
                     logger.debug("compiled[%s]: AOT compile failed "
                                  "(%s); deferring to jit dispatch",
                                  self.site, exc)

@@ -63,7 +63,11 @@ stats to whole-run accounting. Three pieces:
    - a step-time spike detector: a step slower than
      ``MXNET_RUNPROF_SPIKE_FACTOR`` x the rolling window median;
    - a loss plateau / divergence heuristic over the rolling loss
-     window.
+     window;
+   - the memory-leak sentinel (``mxnet_tpu/memprof.py``) books its
+     trips here as ``kind="memory_leak"`` — live device bytes growing
+     monotonically with no matching memory-ledger growth — so leaks
+     join the same anomaly ring, flight-recorder dump, and halt knob.
 
    Every trip bumps ``run_anomalies_total{kind=}``, appends to the
    bounded anomaly log, emits a ``run.anomaly`` event, and dumps the

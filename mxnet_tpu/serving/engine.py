@@ -71,6 +71,7 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from .. import chaos
+from .. import memprof
 from .. import telemetry
 from .. import threadsan
 from .. import xla_stats
@@ -235,6 +236,21 @@ class InferenceEngine:
         # same host-side dict (device copies happen at bind)
         params = Predictor._load_params(param_bytes) \
             if not isinstance(param_bytes, dict) else param_bytes
+
+        # HBM admission control (ROADMAP item 3(b)): refuse a model the
+        # devices cannot hold BEFORE any replica binds device copies or
+        # warmup compiles. The projection is per-device shard bytes of
+        # the params times the replica count; MemoryAdmissionError
+        # propagates (the clear refusal the caller asked for), any
+        # other projection failure must not block a load
+        try:
+            projected = xla_stats.tree_shard_bytes(params) * len(ctxs)
+        except Exception as exc:
+            telemetry.swallowed("serving.admit_projection", exc)
+            projected = 0
+        if projected:
+            memprof.admit(projected, what="serving model load "
+                          "(%d replica(s))" % len(ctxs))
 
         self._replicas = []
         for i, rctx in enumerate(ctxs):
@@ -568,8 +584,11 @@ class InferenceEngine:
     def stats(self):
         """Live snapshot for health endpoints. ``queue_depth`` /
         ``pending`` / ``slo.burn_rate`` are the saturation signals a
-        load balancer can act on before the drain flags flip."""
-        return {
+        load balancer can act on before the drain flags flip; the
+        memory-headroom triple (``headroom_bytes`` / ``peak_fraction``
+        / ``admission_rejections_total``) is the capacity signal for
+        placing the NEXT model."""
+        st = {
             "queue_depth": self._queue.qsize(),
             "pending": self._pending,
             "slo": self._slo.snapshot(),
@@ -583,6 +602,11 @@ class InferenceEngine:
             "draining": self._draining,
             "closed": self._closed,
         }
+        try:
+            st.update(memprof.health())
+        except Exception as exc:
+            telemetry.swallowed("serving.memprof_health", exc)
+        return st
 
     @property
     def buckets(self):
@@ -806,6 +830,13 @@ class InferenceEngine:
         telemetry.counter("serving_batches_total",
                           help="dispatched micro-batches by bucket",
                           bucket=str(batch.bucket)).inc()
+        # memory anatomy: batch completion is the serving-side timeline
+        # sample point (throttled inside memprof; post-readback so the
+        # sample sees the batch's buffers at their live peak)
+        try:
+            memprof.sample("serving.batch")
+        except Exception as exc:
+            telemetry.swallowed("serving.memprof", exc)
         counts = [r.n for r in live]
         splits = [split_rows(o, counts) for o in outs]
         t_split = time.monotonic()

@@ -17,7 +17,12 @@ exposing:
   body carries the saturation signals too — ``queue_depth``,
   ``pending`` (in-flight), and ``slo.burn_rate`` per window — so a
   balancer can shift traffic off a saturated-but-alive replica, not
-  just a draining one.
+  just a draining one. Next to that saturation triple rides the memory
+  headroom triple from ``memprof`` — ``headroom_bytes`` (tightest
+  device's remaining ``limit × MXNET_MEM_FRACTION`` budget),
+  ``peak_fraction`` (worst device peak / limit), and
+  ``admission_rejections_total`` — so a placer can tell "this host
+  cannot take another model" apart from "this host is busy".
 - ``GET /metrics`` — the whole telemetry registry as Prometheus text
   (`telemetry.dumps()`): serving counters/histograms, compile
   accounting, everything the process recorded.

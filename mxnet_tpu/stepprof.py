@@ -309,6 +309,16 @@ class StepProfiler:
                         isinstance(exc, runprof.RunHealthError):
                     raise   # MXNET_RUNPROF_HALT: the spike stops the run
                 telemetry.swallowed("stepprof.runprof", exc)
+            # memory anatomy: step records are one of the three
+            # timeline sample points (throttled inside memprof)
+            try:
+                from . import memprof
+                memprof.sample("step")
+            except Exception as exc:
+                if runprof is not None and \
+                        isinstance(exc, runprof.RunHealthError):
+                    raise   # leak sentinel under MXNET_RUNPROF_HALT
+                telemetry.swallowed("stepprof.memprof", exc)
         self._maybe_export()
 
     def reset(self):

@@ -117,6 +117,13 @@ def bench_resnet50_train():
             rec["goodput_fraction"] = pb["goodput_fraction"]
         if isinstance(pb.get("run_states"), dict):
             rec["run_states"] = pb["run_states"]
+        # memory anatomy: worst-device peak + scope waterfall, gated by
+        # bench_gate as peak_hbm_bytes (lower-better ceiling) with a
+        # bench_gate_memory per-scope delta line on regression
+        if isinstance(pb.get("peak_hbm_bytes"), (int, float)):
+            rec["peak_hbm_bytes"] = pb["peak_hbm_bytes"]
+        if isinstance(pb.get("memory_scopes"), dict):
+            rec["memory_scopes"] = pb["memory_scopes"]
     return rec
 
 

@@ -57,6 +57,13 @@ MULTICHIP_METRIC = "multichip_scaling_efficiency"
 #: this name gate, and a regression prints a ``bench_gate_states``
 #: state-seconds delta line (the run-state analog of the phase deltas).
 GOODPUT_METRIC = "train_goodput_fraction"
+#: memory-anatomy peak (worst-device HBM bytes, LOWER is better — a
+#: ceiling, not a floor). Carried as a ``peak_hbm_bytes`` field on the
+#: TRAIN and MULTICHIP records (bench_all / the graft entry fold the
+#: memprof sample in); both that field and standalone records under
+#: this name gate, and a regression prints a ``bench_gate_memory``
+#: per-scope byte delta line (which attribution scope grew).
+MEMORY_METRIC = "peak_hbm_bytes"
 DEFAULT_THRESHOLD = 0.10
 #: the multichip weak-scaling ratio is measured on a forced-CPU virtual
 #: mesh whose run-to-run spread is ~+-15%; gating it at the default 10%
@@ -66,9 +73,11 @@ MULTICHIP_THRESHOLD = 0.25
 
 
 def lower_is_better(metric):
-    """Latency-style metrics regress UP: the gate direction, the
-    history "best", and the pass bound all flip for them."""
-    return metric.endswith("_ms") or metric.endswith("_seconds")
+    """Latency- and memory-style metrics regress UP: the gate
+    direction, the history "best", and the pass bound all flip for
+    them (``_bytes`` covers peak_hbm_bytes and its serving variant)."""
+    return metric.endswith("_ms") or metric.endswith("_seconds") \
+        or metric.endswith("_bytes")
 
 
 def _improves(new, old, lower):
@@ -98,21 +107,25 @@ def _numeric(v):
 def load_history(history_dir=None, with_phases=False):
     """{metric: [(value, source), ...]} from the recorded rounds.
 
-    ``with_phases=True`` returns ``(history, phases, comm, states)``
-    where ``phases`` maps ``(metric, source)`` to the ``"phases"`` share
-    dict of the best record that source saw (absent for rounds recorded
-    before the step-time profiler existed), ``comm`` likewise maps to
-    the best record's ``"collectives"`` inventory (bytes/step by kind —
-    absent before the communication profiler existed), and ``states``
-    to the best record's ``"run_states"`` seconds dict (absent before
-    the run profiler existed). A record carrying a numeric
-    ``goodput_fraction`` field also contributes it to the
-    :data:`GOODPUT_METRIC` history."""
+    ``with_phases=True`` returns ``(history, phases, comm, states,
+    memory)`` where ``phases`` maps ``(metric, source)`` to the
+    ``"phases"`` share dict of the best record that source saw (absent
+    for rounds recorded before the step-time profiler existed),
+    ``comm`` likewise maps to the best record's ``"collectives"``
+    inventory (bytes/step by kind — absent before the communication
+    profiler existed), ``states`` to the best record's ``"run_states"``
+    seconds dict (absent before the run profiler existed), and
+    ``memory`` to the best record's ``"memory_scopes"`` byte dict
+    (absent before the memory profiler existed). A record carrying a
+    numeric ``goodput_fraction`` field also contributes it to the
+    :data:`GOODPUT_METRIC` history, and one carrying a numeric
+    ``peak_hbm_bytes`` field to the :data:`MEMORY_METRIC` history."""
     history_dir = history_dir or REPO
     out = {}
     phases = {}
     comm = {}
     states = {}
+    memory = {}
 
     def add(metric, value, source, rec=None):
         if not (metric and _numeric(value)):
@@ -134,10 +147,19 @@ def load_history(history_dir=None, with_phases=False):
             prev = states.get((metric, source))
             if prev is None or _improves(float(value), prev[0], lower):
                 states[(metric, source)] = (float(value), st)
+        ms = (rec or {}).get("memory_scopes")
+        if isinstance(ms, dict):
+            prev = memory.get((metric, source))
+            if prev is None or _improves(float(value), prev[0], lower):
+                memory[(metric, source)] = (float(value), ms)
         gf = (rec or {}).get("goodput_fraction")
         if metric != GOODPUT_METRIC and _numeric(gf):
             add(GOODPUT_METRIC, gf, source,
                 {"run_states": (rec or {}).get("run_states")})
+        phb = (rec or {}).get("peak_hbm_bytes")
+        if metric != MEMORY_METRIC and _numeric(phb):
+            add(MEMORY_METRIC, phb, source,
+                {"memory_scopes": (rec or {}).get("memory_scopes")})
 
     # MULTICHIP_r*.json rounds carry the scaling-efficiency metric line
     # in their "tail" the same way BENCH rounds carry the TRAIN one
@@ -187,7 +209,8 @@ def load_history(history_dir=None, with_phases=False):
     if with_phases:
         return (out, {k: ph for k, (_v, ph) in phases.items()},
                 {k: co for k, (_v, co) in comm.items()},
-                {k: st for k, (_v, st) in states.items()})
+                {k: st for k, (_v, st) in states.items()},
+                {k: ms for k, (_v, ms) in memory.items()})
     return out
 
 
@@ -324,6 +347,43 @@ def _states_delta_line(records, metric, best_src, state_hist, out):
     out.write(json.dumps(line) + "\n")
 
 
+def _memory_delta_line(records, metric, best_src, mem_hist, out):
+    """On a peak-HBM regression, print the memory anatomy next to the
+    failure: the run's per-scope attribution bytes, the best round's,
+    and the biggest movers — which scope (params / grads / optimizer /
+    residual activations / XLA temp) grew the peak."""
+    run_scopes = None
+    for rec in records:
+        if isinstance(rec.get("memory_scopes"), dict) and (
+                rec.get("metric") == metric or
+                _numeric(rec.get("peak_hbm_bytes"))):
+            run_scopes = rec["memory_scopes"]
+    best_scopes = mem_hist.get((metric, best_src))
+    line = {"metric": "bench_gate_memory", "gated": metric}
+    if run_scopes:
+        line["run"] = run_scopes
+    if best_scopes:
+        line["best"] = dict(best_scopes, _source=best_src)
+    if run_scopes and best_scopes:
+        deltas = {s: round(float(run_scopes.get(s, 0))
+                           - float(best_scopes.get(s, 0)), 1)
+                  for s in set(run_scopes) | set(best_scopes)
+                  if s != "_source"}
+        movers = sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:3]
+        line["delta"] = deltas
+        line["detail"] = "scope shift vs %s: %s" % (
+            best_src, ", ".join("%s %+.0f B" % (s, d)
+                                for s, d in movers))
+    elif run_scopes:
+        line["detail"] = ("run carries scope attribution but %s "
+                          "recorded none" % best_src)
+    else:
+        line["detail"] = ("no memory attribution in this run — rerun "
+                          "with memprof enabled (MXNET_MEMPROF) for a "
+                          "pre-diagnosed failure")
+    out.write(json.dumps(line) + "\n")
+
+
 def gate_records(records, history_dir=None, metric=None,
                  threshold=None, strict=False, out=None):
     """Gate already-parsed run records; returns the process exit code.
@@ -332,7 +392,7 @@ def gate_records(records, history_dir=None, metric=None,
     ``out`` defaults to the CURRENT sys.stdout (resolved per call, so
     redirected/captured stdout works)."""
     out = out if out is not None else sys.stdout
-    history, phase_hist, comm_hist, state_hist = load_history(
+    history, phase_hist, comm_hist, state_hist, mem_hist = load_history(
         history_dir, with_phases=True)
 
     def say(status, detail, **extra):
@@ -348,6 +408,10 @@ def gate_records(records, history_dir=None, metric=None,
             # run-anatomy field on the TRAIN record gates as its own
             # metric (bench_all folds the attribution pass in)
             by_metric[GOODPUT_METRIC] = float(rec["goodput_fraction"])
+        if _numeric(rec.get("peak_hbm_bytes")):
+            # memory-anatomy field on the TRAIN/MULTICHIP records gates
+            # as its own metric (lower-better ceiling)
+            by_metric[MEMORY_METRIC] = float(rec["peak_hbm_bytes"])
 
     if metric is None:
         # the TRAIN north-star when the run produced it, else the
@@ -400,6 +464,8 @@ def gate_records(records, history_dir=None, metric=None,
             _comm_delta_line(records, metric, best_src, comm_hist, out)
         elif metric == GOODPUT_METRIC:
             _states_delta_line(records, metric, best_src, state_hist, out)
+        elif metric == MEMORY_METRIC:
+            _memory_delta_line(records, metric, best_src, mem_hist, out)
         return 0
 
     say("fail", "%s regressed: %.2f %s %s %.2f (best %.2f from %s, "
@@ -415,6 +481,10 @@ def gate_records(records, history_dir=None, metric=None,
         # a goodput regression is pre-diagnosed with the run-state
         # seconds movers (which badput state grew)
         _states_delta_line(records, metric, best_src, state_hist, out)
+    elif metric == MEMORY_METRIC:
+        # a peak-HBM regression is pre-diagnosed with the per-scope
+        # byte movers (which attribution scope grew the peak)
+        _memory_delta_line(records, metric, best_src, mem_hist, out)
     else:
         _phase_delta_line(records, metric, best_src, phase_hist, out)
     return 1

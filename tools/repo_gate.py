@@ -18,7 +18,10 @@ Runs the two gates that share exit-code conventions (0 = pass,
   the run carries MULTICHIP records, PLUS the run-anatomy goodput gate
   (``train_goodput_fraction``, higher is better, a
   ``bench_gate_states`` state-seconds delta line on regression)
-  whenever the run carries a ``goodput_fraction``.
+  whenever the run carries a ``goodput_fraction``, PLUS the memory
+  gate (``peak_hbm_bytes``, a lower-better ceiling, a
+  ``bench_gate_memory`` per-scope byte delta line on regression)
+  whenever the run carries a ``peak_hbm_bytes``.
 
 ``--threads`` additionally runs the launched concurrency tests under
 ``MXNET_THREADSAN=1`` with a scratch witness dir
@@ -161,6 +164,14 @@ def main(argv=None):
             # is better; a regression prints the state-seconds deltas)
             rc = max(rc, bench_gate.gate_records(
                 records, metric=bench_gate.GOODPUT_METRIC, **kwargs))
+        if any(rec.get("metric") == bench_gate.MEMORY_METRIC
+               or isinstance(rec.get("peak_hbm_bytes"), (int, float))
+               for rec in records):
+            # a run carrying memory-anatomy peak bytes also gates it
+            # (lower-better ceiling; a regression prints the per-scope
+            # byte deltas via bench_gate_memory)
+            rc = max(rc, bench_gate.gate_records(
+                records, metric=bench_gate.MEMORY_METRIC, **kwargs))
 
     return rc
 

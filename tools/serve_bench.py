@@ -311,6 +311,17 @@ def bench_records(clients=8, requests_per_client=25, qps=150.0,
         records.append({"metric": "serving_cold_compiles",
                         "value": engine.cold_compiles(),
                         "unit": "compiles"})
+        # memory anatomy: peak bytes ride alongside p99, so a latency
+        # regression and a memory regression read from the same run
+        try:
+            from mxnet_tpu import memprof
+            records.append({"metric": "serving_peak_hbm_bytes",
+                            "value": memprof.peak_hbm_bytes(),
+                            "unit": "bytes"})
+        except Exception as e:
+            records.append({"metric": "serving_peak_hbm_bytes",
+                            "value": None, "unit": "bytes",
+                            "error": str(e)[:200]})
     finally:
         engine.shutdown()
     return records
